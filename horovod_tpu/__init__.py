@@ -1,0 +1,296 @@
+"""horovod_tpu — a TPU-native distributed training framework with Horovod's
+capabilities, rebuilt from scratch on JAX/XLA.
+
+Public API parity map (reference: ``jayhpark530/horovod``, a snapshot of
+upstream Horovod; see SURVEY.md):
+
+=====================================  =====================================
+Reference († upstream path)            Here
+=====================================  =====================================
+``hvd.init()``                         :func:`init`
+``hvd.rank()/size()/local_*``          :func:`rank` / :func:`size` / ...
+``hvd.allreduce`` (+``_async_``)       :func:`allreduce` / :func:`allreduce_async`
+``hvd.grouped_allreduce``              :func:`grouped_allreduce`
+``hvd.allgather`` / ``alltoall``       :func:`allgather` / :func:`alltoall`
+``hvd.broadcast``                      :func:`broadcast`
+``hvd.synchronize/poll`` (torch)       :func:`synchronize` / :func:`poll`
+``hvd.DistributedOptimizer``           :class:`optim.DistributedOptimizer`
+``hvd.broadcast_parameters``           :func:`broadcast_parameters`
+``hvd.elastic.run`` / ``State``        :mod:`horovod_tpu.elastic`
+``horovodrun``                         ``hvdrun`` (:mod:`horovod_tpu.runner`)
+``hvd.add_process_set``                :func:`add_process_set`
+``hvd.join()``                         :func:`join`
+=====================================  =====================================
+
+Usage::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    g = hvd.per_rank_from_fn(lambda r: np.full((4,), r, np.float32))
+    avg = hvd.allreduce(g)              # replicated mean across ranks
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Optional, Sequence
+
+from . import config  # noqa: F401
+from .context import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    mesh,
+    global_state,
+    NotInitializedError,
+)
+from .ops import (  # noqa: F401
+    ReduceOp,
+    Average,
+    Sum,
+    Min,
+    Max,
+    Product,
+    Adasum,
+    allreduce,
+    grouped_allreduce,
+    allgather,
+    broadcast,
+    alltoall,
+    reducescatter,
+    barrier,
+    per_rank,
+    per_rank_from_fn,
+    to_numpy,
+)
+from .ops.engine import Handle, HorovodInternalError, TensorTableEntry
+from .ops import collectives as _C
+
+__version__ = "0.1.0"
+
+_name_counter = itertools.count()
+
+
+def _auto_name(prefix: str, name: Optional[str]) -> str:
+    # † reference auto-names tensors per framework op when name is omitted.
+    return name if name is not None else f"{prefix}.noname.{next(_name_counter)}"
+
+
+def _engine():
+    state = global_state()
+    if not state.initialized or state.engine is None:
+        raise NotInitializedError()
+    return state.engine
+
+
+# ---------------------------------------------------------------------------
+# Async verbs († horovod/torch *_async_ + synchronize/poll)
+# ---------------------------------------------------------------------------
+
+def allreduce_async(x: Any, op: ReduceOp = Average, *,
+                    name: Optional[str] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    process_set=None) -> Handle:
+    """Enqueue an allreduce; returns a :class:`Handle` immediately.
+
+    Entries enqueued within one engine cycle fuse into a single compiled
+    collective (the fusion-buffer path) — this is the hot call
+    ``DistributedOptimizer`` gradient hooks use.
+    """
+    entry = TensorTableEntry(
+        name=_auto_name("allreduce", name), verb="allreduce",
+        payload=_C.as_per_rank(x, process_set), op=op,
+        prescale=prescale_factor, postscale=postscale_factor,
+        process_set=process_set)
+    return _engine().enqueue(entry)
+
+
+def allgather_async(x: Any, *, name: Optional[str] = None,
+                    process_set=None) -> Handle:
+    entry = TensorTableEntry(
+        name=_auto_name("allgather", name), verb="allgather",
+        payload=x if isinstance(x, (list, tuple)) else _C.as_per_rank(x, process_set),
+        process_set=process_set)
+    return _engine().enqueue(entry)
+
+
+def broadcast_async(x: Any, root_rank: int, *, name: Optional[str] = None,
+                    process_set=None) -> Handle:
+    entry = TensorTableEntry(
+        name=_auto_name("broadcast", name), verb="broadcast",
+        payload=_C.as_per_rank(x, process_set), root_rank=root_rank,
+        process_set=process_set)
+    return _engine().enqueue(entry)
+
+
+def alltoall_async(x: Any, splits: Optional[Sequence[int]] = None, *,
+                   name: Optional[str] = None, process_set=None) -> Handle:
+    entry = TensorTableEntry(
+        name=_auto_name("alltoall", name), verb="alltoall",
+        payload=_C.as_per_rank(x, process_set), splits=splits,
+        process_set=process_set)
+    return _engine().enqueue(entry)
+
+
+def reducescatter_async(x: Any, op: ReduceOp = Sum, *,
+                        name: Optional[str] = None, process_set=None) -> Handle:
+    entry = TensorTableEntry(
+        name=_auto_name("reducescatter", name), verb="reducescatter",
+        payload=_C.as_per_rank(x, process_set), op=op, process_set=process_set)
+    return _engine().enqueue(entry)
+
+
+def synchronize(handle: Handle) -> Any:
+    """Block until an async collective completes; return its output
+    († ``hvd.synchronize`` / ``HandleManager::ReleaseHandle``).
+
+    Nudges the engine for an immediate cycle so the blocking caller does not
+    wait out the cycle time.
+    """
+    if not handle.poll():
+        _engine().nudge()
+    return handle.wait()
+
+
+def poll(handle: Handle) -> bool:
+    """True once the async collective has completed († ``hvd.poll``)."""
+    return handle.poll()
+
+
+# ---------------------------------------------------------------------------
+# Pytree conveniences († broadcast_parameters / broadcast_object)
+# ---------------------------------------------------------------------------
+
+def _root_process_of_rank(root_rank: int) -> int:
+    state = global_state()
+    return state.devices[root_rank].process_index
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
+    """Broadcast a pytree of host/device arrays from root; result replicated.
+
+    † ``horovod/torch/__init__.py broadcast_parameters`` — the step-0 weight
+    sync.  Single-process: one copy of the values exists, so this re-places
+    them replicated on the mesh.  Multi-process: the process owning
+    ``root_rank``'s device is the source and every host receives its values
+    (via the coordination-service broadcast), so diverged initializations
+    cannot leak in.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    state = global_state()
+    if not state.initialized:
+        raise NotInitializedError()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        src = _root_process_of_rank(root_rank) == jax.process_index()
+        params = multihost_utils.broadcast_one_to_all(
+            jax.tree.map(np.asarray, params), is_source=src)
+    sharding = NamedSharding(state.mesh, P())
+    return jax.tree.map(
+        lambda a: jax.device_put(np.asarray(a), sharding), params)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
+    """Pickle-broadcast an arbitrary object from root
+    († ``hvd.broadcast_object``).
+
+    Multi-process: two-phase broadcast (length, then padded pickle buffer)
+    through the coordination service, since buffer shapes must agree on every
+    host; non-source hosts contribute zero-filled placeholders.
+    """
+    import jax
+    if jax.process_count() > 1:
+        import pickle
+        import numpy as np
+        from jax.experimental import multihost_utils
+        src = _root_process_of_rank(root_rank) == jax.process_index()
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        length = int(multihost_utils.broadcast_one_to_all(
+            np.int64(payload.size), is_source=src))
+        buf = payload if src else np.zeros((length,), np.uint8)
+        buf = multihost_utils.broadcast_one_to_all(buf, is_source=src)
+        return pickle.loads(bytes(buf))
+    return obj
+
+
+def allgather_object(objs: Sequence[Any], process_set=None) -> list:
+    """Gather one picklable object per rank († ``hvd.allgather_object``).
+
+    Single-controller semantics: the caller *is* every rank, so it must pass
+    the per-rank sequence explicitly (length == set size); the gathered
+    result is that list.  Anything else is rejected rather than guessed at.
+    """
+    n = process_set.size() if process_set is not None else size()
+    if not isinstance(objs, (list, tuple)) or len(objs) != n:
+        raise ValueError(
+            f"allgather_object expects one object per rank "
+            f"(a sequence of length {n}); got {type(objs).__name__}"
+            + (f" of length {len(objs)}" if isinstance(objs, (list, tuple))
+               else ""))
+    return list(objs)
+
+
+# ---------------------------------------------------------------------------
+# Process sets
+# ---------------------------------------------------------------------------
+
+def add_process_set(ranks: Sequence[int]):
+    """Create a subgroup usable as ``process_set=`` on any verb
+    († ``hvd.add_process_set``, v0.23)."""
+    state = global_state()
+    if not state.initialized:
+        raise NotInitializedError()
+    return state.process_set_table.add(ranks)
+
+
+def remove_process_set(ps) -> None:
+    state = global_state()
+    if not state.initialized:
+        raise NotInitializedError()
+    state.process_set_table.remove(ps)
+
+
+def global_process_set():
+    state = global_state()
+    if not state.initialized:
+        raise NotInitializedError()
+    return state.process_set_table.global_set
+
+
+# ---------------------------------------------------------------------------
+# join() — uneven-input termination
+# ---------------------------------------------------------------------------
+
+def join(rank_done: Optional[int] = None) -> int:
+    """Signal this rank has no more input († ``hvd.join()``,
+    ``RequestType::JOIN``: a joined rank participates as zero tensors until
+    all ranks join; returns the last rank to join).
+
+    Single-controller form: callers pass ``rank_done`` per logical rank via
+    the higher-level ``JoinBarrier`` in :mod:`horovod_tpu.elastic`; bare
+    ``join()`` drains outstanding work and returns ``size()-1``.
+    """
+    barrier()
+    return size() - 1
+
+
+# Optimizer/elastic API re-export (imported lazily so collective-only users
+# don't pay the optax import at package load).
+def __getattr__(name: str):
+    if name in ("DistributedOptimizer", "DistributedGradientTransformation",
+                "distributed_gradients"):
+        from .optim import distributed
+        return getattr(distributed, name)
+    if name == "elastic":
+        import importlib
+        return importlib.import_module("horovod_tpu.elastic")
+    raise AttributeError(f"module 'horovod_tpu' has no attribute {name!r}")

@@ -1,0 +1,189 @@
+"""Configuration knob registry for horovod_tpu.
+
+The reference funnels ~40 ``HOROVOD_*`` environment variables through
+``horovod/common/utils/env_parser.cc`` (†) and mirrors each one as a
+``horovodrun`` CLI flag and a ``--config-file`` YAML key (†
+``horovod/runner/common/util/config_parser.py``).  We keep that three-surface
+model but with a single dataclass as the source of truth: every knob is
+declared once here, and the env parser, CLI flags (``horovod_tpu/runner``)
+and YAML loader are generated from this table.
+
+Env vars are read with both the ``HVDTPU_`` prefix (native) and the
+``HOROVOD_`` prefix (compatibility with reference deployments); ``HVDTPU_``
+wins when both are set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+_TRUE = {"1", "true", "yes", "on"}
+_FALSE = {"0", "false", "no", "off", ""}
+
+
+def _parse_bool(v: str) -> bool:
+    lv = v.strip().lower()
+    if lv in _TRUE:
+        return True
+    if lv in _FALSE:
+        return False
+    raise ValueError(f"cannot parse boolean from {v!r}")
+
+
+@dataclasses.dataclass
+class Config:
+    """All tunables, with reference-equivalent env names noted.
+
+    Fields tagged ``env=`` are settable via ``HVDTPU_<ENV>`` /
+    ``HOROVOD_<ENV>``.
+    """
+
+    # --- fusion / cycle († fusion_buffer_manager.cc, operations.cc) ---
+    # Tensors enqueued within one cycle are fused into a single compiled
+    # collective dispatch as long as their total payload stays under this
+    # threshold (bytes).  Reference default: 64 MB (HOROVOD_FUSION_THRESHOLD).
+    fusion_threshold: int = 64 * 1024 * 1024
+    # Background cycle period in milliseconds (HOROVOD_CYCLE_TIME).
+    # Reference default 5 ms; on TPU the dispatch itself is async so short
+    # cycles are cheap.
+    cycle_time_ms: float = 5.0
+
+    # --- response/dispatch cache († response_cache.cc) ---
+    # Capacity of the compiled-collective dispatch cache (signature -> jitted
+    # program).  The XLA-compile cache plays the role of the reference's
+    # negotiated-Response cache; this caps our own signature table.
+    cache_capacity: int = 1024
+
+    # --- autotune († parameter_manager.cc) ---
+    autotune: bool = False
+    autotune_log: Optional[str] = None
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+
+    # --- timeline († timeline.cc) ---
+    timeline: Optional[str] = None  # path for Chrome-trace JSON
+    timeline_mark_cycles: bool = False
+
+    # --- stall inspector († stall_inspector.cc) ---
+    stall_check: bool = True
+    stall_warning_time_s: float = 60.0
+    stall_shutdown_time_s: float = 0.0  # 0 = never abort
+
+    # --- logging († logging.cc) ---
+    log_level: str = "warning"  # trace|debug|info|warning|error|fatal
+    log_hide_timestamp: bool = False
+
+    # --- hierarchical collectives († nccl_operations.cc hierarchical mode) ---
+    # On TPU: two-level = ICI within a slice + DCN across slices.
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+
+    # --- elastic († runner/elastic) ---
+    elastic: bool = False
+
+    # --- coordination / rendezvous († gloo_context.cc reads of env) ---
+    coordinator_addr: Optional[str] = None  # host:port of the controller
+    rank_env: Optional[int] = None
+    size_env: Optional[int] = None
+    local_rank_env: Optional[int] = None
+    local_size_env: Optional[int] = None
+    cross_rank_env: Optional[int] = None
+    cross_size_env: Optional[int] = None
+
+    # --- TPU-specific ---
+    # Mesh axis name used for the flat data-parallel ("Horovod") axis.
+    dp_axis_name: str = "hvd"
+    # Force CPU backend for collectives (dev rig); normally inherited from JAX.
+    cpu_operations: bool = False
+
+
+# (field name, env suffix, parser) — the env surface, mirroring the
+# reference's env_parser.cc table.
+_ENV_TABLE = [
+    ("fusion_threshold", "FUSION_THRESHOLD", int),
+    ("cycle_time_ms", "CYCLE_TIME", float),
+    ("cache_capacity", "CACHE_CAPACITY", int),
+    ("autotune", "AUTOTUNE", _parse_bool),
+    ("autotune_log", "AUTOTUNE_LOG", str),
+    ("autotune_warmup_samples", "AUTOTUNE_WARMUP_SAMPLES", int),
+    ("autotune_steps_per_sample", "AUTOTUNE_STEPS_PER_SAMPLE", int),
+    ("timeline", "TIMELINE", str),
+    ("timeline_mark_cycles", "TIMELINE_MARK_CYCLES", _parse_bool),
+    ("stall_check", "STALL_CHECK_DISABLE", lambda v: not _parse_bool(v)),
+    ("stall_warning_time_s", "STALL_CHECK_TIME_SECONDS", float),
+    ("stall_shutdown_time_s", "STALL_SHUTDOWN_TIME_SECONDS", float),
+    ("log_level", "LOG_LEVEL", str),
+    ("log_hide_timestamp", "LOG_HIDE_TIME", _parse_bool),
+    ("hierarchical_allreduce", "HIERARCHICAL_ALLREDUCE", _parse_bool),
+    ("hierarchical_allgather", "HIERARCHICAL_ALLGATHER", _parse_bool),
+    ("elastic", "ELASTIC", _parse_bool),
+    ("coordinator_addr", "COORDINATOR_ADDR", str),
+    ("rank_env", "RANK", int),
+    ("size_env", "SIZE", int),
+    ("local_rank_env", "LOCAL_RANK", int),
+    ("local_size_env", "LOCAL_SIZE", int),
+    ("cross_rank_env", "CROSS_RANK", int),
+    ("cross_size_env", "CROSS_SIZE", int),
+    ("cpu_operations", "CPU_OPERATIONS", _parse_bool),
+]
+
+_PREFIXES = ("HVDTPU_", "HOROVOD_")
+
+
+def _env_lookup(suffix: str) -> Optional[str]:
+    for prefix in _PREFIXES:
+        v = os.environ.get(prefix + suffix)
+        if v is not None:
+            return v
+    return None
+
+
+def from_env(base: Optional[Config] = None) -> Config:
+    """Build a Config from the environment, starting from ``base`` defaults."""
+    cfg = dataclasses.replace(base) if base is not None else Config()
+    for field, suffix, parser in _ENV_TABLE:
+        raw = _env_lookup(suffix)
+        if raw is None:
+            continue
+        try:
+            setattr(cfg, field, parser(raw))
+        except (ValueError, TypeError) as e:
+            raise ValueError(
+                f"bad value {raw!r} for env knob {suffix}: {e}") from None
+    return cfg
+
+
+def from_yaml(path: str, base: Optional[Config] = None) -> Config:
+    """Load knobs from a YAML/flat ``key: value`` config file.
+
+    Mirrors the reference's ``--config-file`` surface (†
+    ``runner/common/util/config_parser.py``).  We parse a flat ``key: value``
+    subset without requiring PyYAML (not a guaranteed dependency).
+    """
+    cfg = dataclasses.replace(base) if base is not None else Config()
+    valid = {f.name: f for f in dataclasses.fields(Config)}
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if ":" not in line:
+                raise ValueError(f"{path}:{lineno}: expected 'key: value'")
+            key, _, val = line.partition(":")
+            key = key.strip().replace("-", "_")
+            val = val.strip()
+            if key not in valid:
+                raise ValueError(f"{path}:{lineno}: unknown knob {key!r}")
+            current = getattr(cfg, key)
+            if isinstance(current, bool):
+                parsed: Any = _parse_bool(val)
+            elif isinstance(current, int):
+                parsed = int(val)
+            elif isinstance(current, float):
+                parsed = float(val)
+            else:
+                parsed = val
+            setattr(cfg, key, parsed)
+    return cfg

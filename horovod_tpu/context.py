@@ -1,0 +1,221 @@
+"""Global runtime context: the TPU-native equivalent of the reference's
+``HorovodGlobalState`` + ``Controller`` rank bookkeeping.
+
+Reference semantics († ``horovod/common/operations.cc`` ``horovod_init`` /
+``horovod_rank`` / ``horovod_size``; † ``horovod/common/basics.py``):
+every *process* is one rank, owning exactly one accelerator, and collectives
+run across processes.
+
+TPU-native mapping: JAX is a single-controller-per-host SPMD system where one
+process drives several chips, so the *collective participant* is the device,
+not the process:
+
+- ``size()``        = number of devices in the global mesh (all hosts)
+- ``rank()``        = global index of this process's first addressable device
+- ``local_size()``  = number of devices this process drives
+- ``local_rank()``  = index of the process among processes on this host (0 in
+                      single-host mode), matching the reference's use of
+                      local_rank for GPU pinning — on TPU, device pinning is
+                      automatic, so this is informational
+- ``cross_rank()``  = process index (host index across the job)
+- ``cross_size()``  = process count
+
+The 8-fake-device CPU rig (``--xla_force_host_platform_device_count=8``) then
+behaves like ``horovodrun -np 8`` for testing: 8 participants, one process.
+
+Multi-host: ``init()`` calls ``jax.distributed.initialize`` when a coordinator
+address is configured (env ``HVDTPU_COORDINATOR_ADDR`` or args), after which
+``jax.devices()`` spans all hosts and the same code paths work unchanged —
+XLA's ICI/DCN collectives replace the reference's NCCL/MPI split.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from . import config as config_mod
+from .utils import logging as hvd_logging
+
+log = hvd_logging.get_logger()
+
+
+class NotInitializedError(RuntimeError):
+    def __init__(self) -> None:
+        super().__init__(
+            "horovod_tpu has not been initialized; call horovod_tpu.init() "
+            "first (reference parity: hvd.init())")
+
+
+class _GlobalState:
+    """Singleton runtime state († ``global_state.h HorovodGlobalState``)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.initialized = False
+        self.config: config_mod.Config = config_mod.Config()
+        self.devices: Sequence[jax.Device] = ()
+        self.mesh: Optional[Mesh] = None          # flat 1-D mesh, axis = dp_axis
+        self.engine = None                        # ops.engine.CollectiveEngine
+        self.timeline = None                      # utils.timeline.Timeline
+        self.process_set_table = None             # ops.process_sets table
+
+    # -- rank bookkeeping ---------------------------------------------------
+    @property
+    def size(self) -> int:
+        return len(self.devices)
+
+    @property
+    def local_devices(self) -> Sequence[jax.Device]:
+        return [d for d in self.devices if d.process_index == jax.process_index()]
+
+    @property
+    def rank(self) -> int:
+        pidx = jax.process_index()
+        for i, d in enumerate(self.devices):
+            if d.process_index == pidx:
+                return i
+        return 0
+
+    @property
+    def local_size(self) -> int:
+        return len(self.local_devices)
+
+
+_state = _GlobalState()
+
+
+def global_state() -> _GlobalState:
+    return _state
+
+
+def init(
+    *,
+    config: Optional[config_mod.Config] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    coordinator_addr: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the runtime (reference parity: ``hvd.init()`` †3.1).
+
+    Single-host: builds the global 1-D mesh over all (or the given) devices
+    and starts the background collective engine.
+
+    Multi-host: pass ``coordinator_addr``/``num_processes``/``process_id`` (or
+    set ``HVDTPU_COORDINATOR_ADDR`` etc.); this performs the rendezvous the
+    reference does via Gloo's HTTP KV store († ``gloo_context.cc
+    InitializeFromEnv``), here via JAX's coordination service.
+    """
+    with _state.lock:
+        if _state.initialized:
+            log.debug("init() called twice; ignoring (reference parity)")
+            return
+
+        cfg = config_mod.from_env(config)
+        hvd_logging.configure(cfg.log_level, hide_timestamp=cfg.log_hide_timestamp)
+        _state.config = cfg
+
+        addr = coordinator_addr or cfg.coordinator_addr
+        if addr:
+            jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=num_processes if num_processes is not None else cfg.cross_size_env,
+                process_id=process_id if process_id is not None else cfg.cross_rank_env,
+            )
+
+        devs = list(devices) if devices is not None else list(jax.devices())
+        if not devs:
+            raise RuntimeError("no JAX devices visible")
+        _state.devices = devs
+        _state.mesh = Mesh(np.array(devs), axis_names=(cfg.dp_axis_name,))
+
+        from .utils.timeline import Timeline
+        _state.timeline = Timeline(cfg.timeline, mark_cycles=cfg.timeline_mark_cycles)
+
+        from .ops.engine import CollectiveEngine
+        _state.engine = CollectiveEngine(_state)
+        _state.engine.start()
+
+        from .ops.process_sets import ProcessSetTable
+        _state.process_set_table = ProcessSetTable(_state)
+
+        _state.initialized = True
+        log.info(
+            "horovod_tpu initialized: size=%d local_size=%d rank=%d backend=%s",
+            _state.size, _state.local_size, _state.rank, jax.default_backend())
+
+
+def shutdown() -> None:
+    """Stop the background engine († ``horovod_shutdown``)."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        if _state.engine is not None:
+            _state.engine.stop()
+            _state.engine = None
+        if _state.timeline is not None:
+            _state.timeline.close()
+            _state.timeline = None
+        _state.mesh = None
+        _state.devices = ()
+        _state.process_set_table = None
+        _state.initialized = False
+
+
+atexit.register(shutdown)
+
+
+def _require_init() -> _GlobalState:
+    if not _state.initialized:
+        raise NotInitializedError()
+    return _state
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def rank() -> int:
+    """Global rank of this process's first device (†``horovod_rank``)."""
+    return _require_init().rank
+
+
+def size() -> int:
+    """Total number of collective participants = devices (†``horovod_size``)."""
+    return _require_init().size
+
+
+def local_rank() -> int:
+    """Process index on this host (†``horovod_local_rank``); 0 single-host."""
+    _require_init()
+    return jax.process_index()  # one process per host in TPU deployments
+
+
+def local_size() -> int:
+    """Number of devices driven by this process (†``horovod_local_size``)."""
+    return _require_init().local_size
+
+
+def cross_rank() -> int:
+    """Host/process index across the job (†``horovod_cross_rank``)."""
+    _require_init()
+    return jax.process_index()
+
+
+def cross_size() -> int:
+    """Number of processes/hosts (†``horovod_cross_size``)."""
+    _require_init()
+    return jax.process_count()
+
+
+def mesh() -> Mesh:
+    """The persistent flat data-parallel mesh collectives dispatch on."""
+    m = _require_init().mesh
+    assert m is not None
+    return m

@@ -1,0 +1,8 @@
+"""Model zoo for benchmarks and end-to-end configs.
+
+The reference ships no model library — its models live in ``examples/`` (†
+``examples/pytorch/pytorch_mnist.py``, ``examples/keras/keras_imagenet_resnet50.py``,
+TF BERT scripts).  The driver's ``BASELINE.json`` names five configs (MNIST
+ConvNet, ResNet-50, BERT-Large, Llama-2 7B, DLRM), so this package hosts
+TPU-first flax implementations of each.
+"""

@@ -1,0 +1,37 @@
+"""MNIST ConvNet — config 1 of BASELINE.json.
+
+Architecture mirrors the reference example's Net (†
+``examples/pytorch/pytorch_mnist.py``: conv10@5x5 → pool → conv20@5x5 →
+dropout2d → pool → fc50 → dropout → fc10), reshaped for TPU friendliness:
+NHWC layout (TPU conv native layout) and channel counts padded toward
+MXU-friendly multiples while keeping the same depth/structure.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvNet(nn.Module):
+    """Small ConvNet for 28x28x1 inputs, 10 classes."""
+
+    features1: int = 16
+    features2: int = 32
+    hidden: int = 64
+    num_classes: int = 10
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, deterministic: bool = True
+                 ) -> jnp.ndarray:
+        # x: [batch, 28, 28, 1] (NHWC)
+        x = nn.Conv(self.features1, (5, 5))(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = nn.Conv(self.features2, (5, 5))(x)
+        x = nn.Dropout(0.25, deterministic=deterministic)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
+        return nn.Dense(self.num_classes)(x)

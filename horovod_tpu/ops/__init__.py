@@ -1,0 +1,29 @@
+"""Collective op layer: the TPU-native replacement for the reference's
+``horovod/common/ops/`` backend tree (†).
+
+Where the reference selects NCCL/MPI/Gloo/oneCCL implementations per response
+(† ``operation_manager.cc``), here every verb lowers to an XLA collective
+(``psum`` / ``all_gather`` / ``all_to_all`` / ``psum_scatter`` /
+``ppermute``) compiled onto a persistent device mesh — ICI within a slice,
+DCN across slices, chosen by XLA from the device topology.
+"""
+
+from .collectives import (  # noqa: F401
+    ReduceOp,
+    Average,
+    Sum,
+    Min,
+    Max,
+    Product,
+    Adasum,
+    allreduce,
+    grouped_allreduce,
+    allgather,
+    broadcast,
+    alltoall,
+    reducescatter,
+    barrier,
+    per_rank,
+    per_rank_from_fn,
+    to_numpy,
+)

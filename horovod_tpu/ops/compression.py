@@ -1,0 +1,70 @@
+"""Gradient compression for cross-rank communication.
+
+† ``horovod/torch/compression.py`` / ``horovod/tensorflow/compression.py``:
+``hvd.Compression.none`` / ``hvd.Compression.fp16`` — floating-point tensors
+are cast down before the allreduce and restored after, halving wire bytes.
+
+TPU-native note: the natural 16-bit format on TPU is bfloat16 (same exponent
+range as fp32 — no loss-scale bookkeeping needed), so ``fp16`` here defaults
+to bf16 payloads with an ``np.float16`` option for exact reference parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface († ``Compression`` class hierarchy)."""
+
+    @staticmethod
+    def compress(tensor: Any) -> tuple[Any, Any]:
+        """Returns (compressed, ctx) where ctx is whatever decompress needs."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor: Any, ctx: Any) -> Any:
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast float tensors to 16-bit for the collective, restore after."""
+
+    wire_dtype = jnp.bfloat16
+
+    @classmethod
+    def compress(cls, tensor):
+        if jnp.issubdtype(tensor.dtype, jnp.floating) and \
+                tensor.dtype.itemsize > 2:
+            return tensor.astype(cls.wire_dtype), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor if ctx is None else tensor.astype(ctx)
+
+
+class IEEEFP16Compressor(FP16Compressor):
+    """Exact reference parity: IEEE float16 wire format."""
+
+    wire_dtype = jnp.float16
+
+
+class Compression:
+    """Namespace matching ``hvd.Compression.{none,fp16}`` (†)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    fp16_ieee = IEEEFP16Compressor

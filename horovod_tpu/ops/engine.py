@@ -1,0 +1,383 @@
+"""Asynchronous collective engine: tensor queue + background fusion cycle.
+
+Reference architecture († ``horovod/common/operations.cc``): framework ops
+enqueue a ``TensorTableEntry`` and return immediately; a background thread
+(``BackgroundThreadLoop`` → ``RunLoopOnce`` every ``HOROVOD_CYCLE_TIME`` ms)
+negotiates readiness across ranks, fuses ready tensors up to
+``HOROVOD_FUSION_THRESHOLD`` bytes, executes one collective per fused batch,
+and fires completion callbacks.  ``synchronize(handle)`` blocks the caller
+(† ``horovod/torch/mpi_ops_v2.cc HandleManager``).
+
+TPU-native redesign:
+
+- *Negotiation* is a pluggable ``Negotiator``.  Single-controller mode (one
+  process drives all devices) needs none — the enqueueing thread is the only
+  source of requests, so everything is trivially "ready on all ranks".
+  Multi-process mode plugs in the native controller
+  (``horovod_tpu/_native``) which runs the reference's rank-0 coordinator
+  protocol over TCP.
+- *Fusion* batches queue entries with matching (verb, reduce-op, dtype,
+  process-set) signatures into one compiled grouped program per cycle
+  († fusion buffer, minus the explicit memcpys — XLA owns HBM layout).
+- *Overlap* comes from JAX async dispatch: the cycle thread enqueues device
+  work and returns without blocking; ``synchronize`` only blocks the caller.
+
+Urgent wakeup: ``synchronize(handle)`` nudges the engine for an immediate
+cycle instead of letting the blocked caller wait out the cycle time, so
+blocking latency ≈ dispatch cost while concurrent async traffic still fuses.
+
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+from . import collectives as C
+from ..utils import logging as hvd_logging
+
+log = hvd_logging.get_logger()
+
+
+class HorovodInternalError(RuntimeError):
+    """A collective failed after being accepted († ``common.h`` status →
+    ``HorovodInternalError`` raised by every framework binding).  Elastic
+    mode catches this to trigger restore/re-rendezvous."""
+
+
+@dataclass
+class TensorTableEntry:
+    """† ``horovod/common/common.h TensorTableEntry`` (name, tensor, context,
+    callback) — payloads here are per-rank jax Arrays."""
+    name: str
+    verb: str                      # allreduce | allgather | broadcast | alltoall | reducescatter
+    payload: Any
+    op: C.ReduceOp = C.ReduceOp.AVERAGE
+    root_rank: int = 0
+    splits: Optional[Sequence[int]] = None
+    prescale: float = 1.0
+    postscale: float = 1.0
+    process_set: Any = None
+    enqueue_time: float = field(default_factory=time.monotonic)
+
+
+class Handle:
+    """Async completion handle († ``handle_manager.cc``: int handle +
+    ``synchronize``)."""
+
+    __slots__ = ("_event", "_result", "_error", "name")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._event = threading.Event()
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+
+    def _complete(self, result: Any = None,
+                  error: Optional[BaseException] = None) -> None:
+        self._result = result
+        self._error = error
+        self._event.set()
+
+    def poll(self) -> bool:
+        """Non-blocking completion check († ``hvd.poll``)."""
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        """Block until complete and return the output († ``hvd.synchronize``)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"collective {self.name!r} still pending")
+        if self._error is not None:
+            raise HorovodInternalError(
+                f"collective {self.name!r} failed: {self._error}"
+            ) from self._error
+        return self._result
+
+
+class Negotiator:
+    """Readiness protocol interface († ``Controller::ComputeResponseList``)."""
+
+    def negotiate(self, entries: list[TensorTableEntry]
+                  ) -> list[TensorTableEntry]:
+        """Return the subset (in agreed order) to execute this cycle."""
+        raise NotImplementedError
+
+
+class SingleControllerNegotiator(Negotiator):
+    """One process sees every request — everything is ready immediately."""
+
+    def negotiate(self, entries: list[TensorTableEntry]
+                  ) -> list[TensorTableEntry]:
+        return entries
+
+
+class CollectiveEngine:
+    """Background cycle thread owning the tensor queue.
+
+    † ``BackgroundThreadLoop`` + ``TensorQueue`` + fusion, restructured so the
+    queue drain → negotiate → fuse → dispatch path is synchronous within one
+    cycle and device execution is left async to JAX.
+    """
+
+    def __init__(self, state, negotiator: Optional[Negotiator] = None) -> None:
+        self._state = state
+        self._negotiator = negotiator or SingleControllerNegotiator()
+        self._queue: list[tuple[TensorTableEntry, Handle]] = []
+        self._names_pending: set[str] = set()
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._urgent = False
+        self._paused = False
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._cycle_count = 0
+        self._last_stall_warn = 0.0
+        self._autotuner = None  # attached lazily when autotune is enabled
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name="hvdtpu-engine", daemon=True)
+        self._thread.start()
+        if self._state.config.autotune:
+            from ..utils.autotune import Autotuner
+            self._autotuner = Autotuner(self._state)
+
+    def stop(self) -> None:
+        with self._wake:
+            self._running = False
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        # Fail any stragglers so synchronize() callers don't hang.
+        with self._lock:
+            for entry, handle in self._queue:
+                handle._complete(error=RuntimeError("engine shut down"))
+            self._queue.clear()
+            self._names_pending.clear()
+
+    def nudge(self) -> None:
+        """Request an immediate cycle (used by ``synchronize`` so a blocking
+        caller doesn't wait out the cycle time)."""
+        with self._wake:
+            self._urgent = True
+            self._wake.notify_all()
+
+    def pause(self) -> None:
+        """Hold queue processing (elastic re-rendezvous; deterministic tests)."""
+        with self._wake:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._wake:
+            self._paused = False
+            self._urgent = True
+            self._wake.notify_all()
+
+    # -- enqueue († EnqueueTensorAllreduce et al.) --------------------------
+    def enqueue(self, entry: TensorTableEntry, *, urgent: bool = False
+                ) -> Handle:
+        handle = Handle(entry.name)
+        with self._wake:
+            if not self._running:
+                handle._complete(error=RuntimeError("engine not running"))
+                return handle
+            if entry.name in self._names_pending:
+                # † TensorQueue rejects duplicate in-flight names.
+                handle._complete(error=ValueError(
+                    f"a collective named {entry.name!r} is already pending"))
+                return handle
+            self._names_pending.add(entry.name)
+            self._queue.append((entry, handle))
+            if urgent:
+                self._urgent = True
+                self._wake.notify_all()
+        return handle
+
+    # -- background loop († RunLoopOnce) ------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._wake:
+                if not self._running:
+                    return
+                if not self._urgent:
+                    self._wake.wait(
+                        timeout=self._state.config.cycle_time_ms / 1000.0)
+                if not self._running:
+                    return
+                self._urgent = False
+                if self._paused:
+                    continue
+                batch = self._queue
+                self._queue = []
+            try:
+                self._run_cycle(batch)
+            except BaseException:  # pragma: no cover - defensive
+                log.exception("engine cycle crashed")
+            try:
+                self._check_stalls()
+            except HorovodInternalError as err:
+                # Stall shutdown: fail every pending handle so all callers
+                # raise († error Response to all ranks), then stop the loop.
+                with self._lock:
+                    pending = self._queue
+                    self._queue = []
+                    self._names_pending.clear()
+                    self._running = False
+                for entry, handle in pending:
+                    handle._complete(error=err)
+                log.error("engine stopped by stall shutdown: %s", err)
+                return
+
+    def _run_cycle(self, batch: list[tuple[TensorTableEntry, Handle]]) -> None:
+        self._cycle_count += 1
+        tl = self._state.timeline
+        if tl is not None:
+            tl.mark_cycle()
+        if not batch:
+            return
+        t0 = time.monotonic()
+        entries = [e for e, _ in batch]
+        handles = {id(e): h for e, h in batch}
+        ready = self._negotiator.negotiate(entries)
+        ready_ids = {id(e) for e in ready}
+        deferred = [(e, h) for e, h in batch if id(e) not in ready_ids]
+        if deferred:
+            with self._lock:
+                self._queue = deferred + self._queue
+        for group in self._fuse(ready):
+            self._execute_group(group, handles)
+        if self._autotuner is not None:
+            payload = sum(self._entry_bytes(e) for e in ready)
+            self._autotuner.record_cycle(payload, time.monotonic() - t0)
+
+    @staticmethod
+    def _entry_bytes(e: TensorTableEntry) -> int:
+        p = e.payload
+        try:
+            return int(p.size * p.dtype.itemsize)
+        except AttributeError:
+            return 0
+
+    def _fuse(self, entries: list[TensorTableEntry]
+              ) -> list[list[TensorTableEntry]]:
+        """Group fusable entries; split at the fusion threshold.
+
+        † fusion_buffer_manager.cc: same dtype+op tensors share a fused
+        dispatch up to ``fusion_threshold`` bytes.  Only allreduce fuses
+        (matching the reference — other verbs execute per-tensor).
+        """
+        threshold = self._state.config.fusion_threshold
+        groups: dict[tuple, list[TensorTableEntry]] = {}
+        order: list[tuple] = []
+        singles: list[list[TensorTableEntry]] = []
+        for e in entries:
+            if e.verb == "allreduce" and e.op is not C.ReduceOp.ADASUM:
+                key = ("allreduce", e.op, str(e.payload.dtype),
+                       id(e.process_set), e.prescale, e.postscale)
+                if key not in groups:
+                    groups[key] = []
+                    order.append(key)
+                groups[key].append(e)
+            else:
+                singles.append([e])
+        fused: list[list[TensorTableEntry]] = []
+        for key in order:
+            current: list[TensorTableEntry] = []
+            current_bytes = 0
+            for e in groups[key]:
+                nbytes = self._entry_bytes(e)
+                if current and current_bytes + nbytes > threshold:
+                    fused.append(current)
+                    current, current_bytes = [], 0
+                current.append(e)
+                current_bytes += nbytes
+            if current:
+                fused.append(current)
+        return fused + singles
+
+    def _execute_group(self, group: list[TensorTableEntry],
+                       handles: dict[int, Handle]) -> None:
+        tl = self._state.timeline
+        names = [e.name for e in group]
+        try:
+            if tl is not None:
+                for n in names:
+                    tl.start_activity(n, "DISPATCH")
+            results = self._dispatch(group)
+            if tl is not None:
+                for n in names:
+                    tl.end_activity(n)
+            for e, r in zip(group, results):
+                with self._lock:
+                    self._names_pending.discard(e.name)
+                handles[id(e)]._complete(result=r)
+        except BaseException as err:
+            # † error Response delivered to every participating rank so all
+            # raise rather than some hanging.
+            for e in group:
+                with self._lock:
+                    self._names_pending.discard(e.name)
+                handles[id(e)]._complete(error=err)
+
+    def _dispatch(self, group: list[TensorTableEntry]) -> list[Any]:
+        e0 = group[0]
+        if e0.verb == "allreduce":
+            if len(group) == 1:
+                return [C.allreduce(e0.payload, e0.op,
+                                    prescale_factor=e0.prescale,
+                                    postscale_factor=e0.postscale,
+                                    process_set=e0.process_set)]
+            return C.grouped_allreduce(
+                [e.payload for e in group], e0.op,
+                prescale_factor=e0.prescale, postscale_factor=e0.postscale,
+                process_set=e0.process_set)
+        assert len(group) == 1
+        if e0.verb == "allgather":
+            return [C.allgather(e0.payload, process_set=e0.process_set)]
+        if e0.verb == "broadcast":
+            return [C.broadcast(e0.payload, e0.root_rank,
+                                process_set=e0.process_set)]
+        if e0.verb == "alltoall":
+            return [C.alltoall(e0.payload, e0.splits,
+                               process_set=e0.process_set)]
+        if e0.verb == "reducescatter":
+            return [C.reducescatter(e0.payload, e0.op,
+                                    process_set=e0.process_set)]
+        raise ValueError(f"unknown verb {e0.verb!r}")
+
+    # -- stall inspector († stall_inspector.cc) ----------------------------
+    def _check_stalls(self) -> None:
+        cfg = self._state.config
+        if not cfg.stall_check:
+            return
+        now = time.monotonic()
+        if now - self._last_stall_warn < cfg.stall_warning_time_s:
+            return
+        with self._lock:
+            stalled = [(e.name, now - e.enqueue_time)
+                       for e, _ in self._queue
+                       if now - e.enqueue_time > cfg.stall_warning_time_s]
+        if stalled:
+            self._last_stall_warn = now
+            desc = ", ".join(f"{n} ({age:.0f}s)" for n, age in stalled)
+            log.warning(
+                "Stall detected: collectives pending > %.0fs without "
+                "completing negotiation: %s. One or more ranks may have "
+                "diverged (e.g. rank-dependent conditionals).",
+                cfg.stall_warning_time_s, desc)
+            if cfg.stall_shutdown_time_s > 0:
+                worst = max(age for _, age in stalled)
+                if worst > cfg.stall_shutdown_time_s:
+                    raise HorovodInternalError(
+                        f"stalled collectives exceeded shutdown time "
+                        f"({cfg.stall_shutdown_time_s}s): {desc}")
+
+    # -- stats --------------------------------------------------------------
+    @property
+    def cycle_count(self) -> int:
+        return self._cycle_count

@@ -1,0 +1,86 @@
+"""Process sets: collectives over subgroups of ranks.
+
+† ``horovod/common/process_set.cc`` (v0.23): a ``ProcessSet`` is a subset of
+global ranks with its own communicators; ops take ``process_set=...``.
+
+TPU-native: a process set owns a sub-``Mesh`` over the subset's devices; the
+collective layer dispatches compiled programs onto that mesh, so XLA builds
+the subgroup communicators (ICI neighbor subsets) instead of NCCL comm splits.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+from jax.sharding import Mesh
+
+
+class ProcessSet:
+    """Subgroup of global ranks usable with every collective verb."""
+
+    def __init__(self, set_id: int, ranks: Sequence[int], state) -> None:
+        self.set_id = set_id
+        self.ranks = tuple(sorted(ranks))
+        if len(set(self.ranks)) != len(self.ranks):
+            raise ValueError(f"duplicate ranks in process set: {ranks}")
+        for r in self.ranks:
+            if not 0 <= r < state.size:
+                raise ValueError(f"rank {r} out of range [0,{state.size})")
+        devices = [state.devices[r] for r in self.ranks]
+        self.axis_name = state.config.dp_axis_name
+        self.mesh = Mesh(np.array(devices), axis_names=(self.axis_name,))
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def rank_of(self, global_rank: int) -> int:
+        """Position of a global rank inside this set (†``ProcessSet::rank``)."""
+        try:
+            return self.ranks.index(global_rank)
+        except ValueError:
+            raise ValueError(
+                f"global rank {global_rank} not in process set "
+                f"{self.ranks}") from None
+
+    def included(self, global_rank: int) -> bool:
+        return global_rank in self.ranks
+
+    def __repr__(self) -> str:
+        return f"ProcessSet(id={self.set_id}, ranks={self.ranks})"
+
+
+class ProcessSetTable:
+    """Registry of process sets († ``process_set.cc ProcessSetTable``).
+
+    Set id 0 is the implicit global set containing every rank.
+    """
+
+    def __init__(self, state) -> None:
+        self._state = state
+        self._lock = threading.Lock()
+        self._next_id = 1
+        self.global_set = ProcessSet(0, range(state.size), state)
+        self._table: Dict[int, ProcessSet] = {0: self.global_set}
+
+    def add(self, ranks: Sequence[int]) -> ProcessSet:
+        with self._lock:
+            ps = ProcessSet(self._next_id, ranks, self._state)
+            self._table[ps.set_id] = ps
+            self._next_id += 1
+            return ps
+
+    def remove(self, ps: ProcessSet) -> None:
+        if ps.set_id == 0:
+            raise ValueError("cannot remove the global process set")
+        with self._lock:
+            self._table.pop(ps.set_id, None)
+
+    def get(self, set_id: int) -> Optional[ProcessSet]:
+        with self._lock:
+            return self._table.get(set_id)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._table)

@@ -1,0 +1,10 @@
+"""Distributed optimization: the TPU-native ``DistributedOptimizer``.
+
+† ``horovod/torch/optimizer.py`` / ``horovod/tensorflow/__init__.py``.
+"""
+
+from .distributed import (  # noqa: F401
+    DistributedOptimizer,
+    DistributedGradientTransformation,
+    distributed_gradients,
+)

@@ -1,0 +1,143 @@
+"""Online autotuning of fusion-threshold and cycle-time.
+
+† ``horovod/common/parameter_manager.cc`` + ``optim/bayesian_optimization.cc``:
+the reference tunes (fusion threshold, cycle time) online with Bayesian
+optimization (Gaussian process + expected improvement) against observed
+throughput, after a warmup, writing decisions to ``HOROVOD_AUTOTUNE_LOG``.
+
+This implementation keeps the same control loop (warmup → propose → score →
+commit best) with a Gaussian-process surrogate implemented in numpy (RBF
+kernel + expected improvement over a candidate grid).  Eigen/LBFGS hyperparam
+refits are replaced by a small fixed-length-scale kernel — adequate for a
+2-D, low-noise search space.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Optional
+
+import numpy as np
+
+# Candidate grid (log2 bytes for threshold, ms for cycle time), spanning the
+# same range the reference explores.
+_THRESHOLDS = [1 << p for p in range(20, 28)]         # 1 MB .. 128 MB
+_CYCLE_TIMES = [0.5, 1.0, 2.5, 5.0, 10.0, 20.0]        # ms
+
+
+class _GP:
+    """Minimal RBF-kernel GP regressor for the 2-D knob space."""
+
+    def __init__(self, length_scale: float = 1.0, noise: float = 1e-3) -> None:
+        self.ls = length_scale
+        self.noise = noise
+        self.X: Optional[np.ndarray] = None
+        self.y: Optional[np.ndarray] = None
+        self._K_inv: Optional[np.ndarray] = None
+
+    def _k(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d / self.ls ** 2)
+
+    def fit(self, X: np.ndarray, y: np.ndarray) -> None:
+        self.X, self.y = X, y
+        K = self._k(X, X) + self.noise * np.eye(len(X))
+        self._K_inv = np.linalg.inv(K)
+
+    def predict(self, Xs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        assert self.X is not None and self._K_inv is not None
+        Ks = self._k(Xs, self.X)
+        mu = Ks @ self._K_inv @ self.y
+        var = 1.0 - np.einsum("ij,jk,ik->i", Ks, self._K_inv, Ks)
+        return mu, np.maximum(var, 1e-12)
+
+
+def _expected_improvement(mu: np.ndarray, var: np.ndarray, best: float
+                          ) -> np.ndarray:
+    sigma = np.sqrt(var)
+    z = (mu - best) / sigma
+    cdf = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2.0)))
+    pdf = np.exp(-0.5 * z ** 2) / math.sqrt(2.0 * math.pi)
+    return (mu - best) * cdf + sigma * pdf
+
+
+class Autotuner:
+    """Propose/score loop attached to the engine's cycle callback."""
+
+    def __init__(self, state) -> None:
+        self._state = state
+        cfg = state.config
+        self._warmup_left = cfg.autotune_warmup_samples
+        self._steps_per_sample = cfg.autotune_steps_per_sample
+        self._log_path = cfg.autotune_log
+        # Normalized candidate grid.
+        self._grid = np.array([
+            (math.log2(t), math.log2(c))
+            for t in _THRESHOLDS for c in _CYCLE_TIMES])
+        self._grid_raw = [(t, c) for t in _THRESHOLDS for c in _CYCLE_TIMES]
+        self._samples_X: list[tuple[float, float]] = []
+        self._samples_y: list[float] = []
+        self._current = (cfg.fusion_threshold, cfg.cycle_time_ms)
+        self._acc_bytes = 0
+        self._acc_time = 0.0
+        self._acc_cycles = 0
+        self._done = False
+
+    def record_cycle(self, payload_bytes: int, cycle_seconds: float) -> None:
+        if self._done or payload_bytes == 0:
+            return
+        self._acc_bytes += payload_bytes
+        self._acc_time += cycle_seconds
+        self._acc_cycles += 1
+        if self._acc_cycles < self._steps_per_sample:
+            return
+        score = self._acc_bytes / max(self._acc_time, 1e-9)  # bytes/s
+        self._acc_bytes, self._acc_time, self._acc_cycles = 0, 0.0, 0
+        if self._warmup_left > 0:
+            self._warmup_left -= 1
+            self._log(f"warmup score={score:.3e}")
+            return
+        t, c = self._current
+        self._samples_X.append((math.log2(t), math.log2(c)))
+        self._samples_y.append(score)
+        self._propose_next()
+
+    def _propose_next(self) -> None:
+        X = np.asarray(self._samples_X)
+        y = np.asarray(self._samples_y)
+        y_norm = (y - y.mean()) / (y.std() + 1e-9)
+        gp = _GP(length_scale=2.0)
+        gp.fit(X, y_norm)
+        mu, var = gp.predict(self._grid)
+        ei = _expected_improvement(mu, var, y_norm.max())
+        idx = int(np.argmax(ei))
+        threshold, cycle = self._grid_raw[idx]
+        self._apply(threshold, cycle)
+        best = int(np.argmax(y))
+        self._log(
+            f"sample #{len(y)} score={y[-1]:.3e} -> next "
+            f"threshold={threshold} cycle_ms={cycle} "
+            f"(best so far {self._raw(best)} @ {y[best]:.3e})")
+        # Convergence: stop after exploring enough with no improvement,
+        # committing the best-seen knobs († ParameterManager stops tuning).
+        if len(y) >= 12 and best < len(y) - 6:
+            bt, bc = self._raw(best)
+            self._apply(bt, bc)
+            self._done = True
+            self._log(f"converged: threshold={bt} cycle_ms={bc}")
+
+    def _raw(self, i: int) -> tuple[int, float]:
+        t, c = self._samples_X[i]
+        return int(round(2 ** t)), float(2 ** c)
+
+    def _apply(self, threshold: int, cycle_ms: float) -> None:
+        self._current = (threshold, cycle_ms)
+        self._state.config.fusion_threshold = threshold
+        self._state.config.cycle_time_ms = cycle_ms
+
+    def _log(self, msg: str) -> None:
+        if not self._log_path:
+            return
+        with open(self._log_path, "a") as fh:
+            fh.write(f"{time.time():.3f} {msg}\n")

@@ -1,0 +1,42 @@
+"""Leveled logging († ``horovod/common/logging.cc``: ``LOG(INFO)`` macros,
+``HOROVOD_LOG_LEVEL``, ``HOROVOD_LOG_HIDE_TIME``).
+
+Python's stdlib logging already provides the mechanism; this module maps the
+reference's level names (including ``trace`` and ``fatal``) onto it and
+applies the env-driven configuration.
+"""
+
+from __future__ import annotations
+
+import logging
+
+TRACE = 5
+logging.addLevelName(TRACE, "TRACE")
+
+_LEVELS = {
+    "trace": TRACE,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+_LOGGER_NAME = "horovod_tpu"
+
+
+def get_logger() -> logging.Logger:
+    return logging.getLogger(_LOGGER_NAME)
+
+
+def configure(level: str, *, hide_timestamp: bool = False) -> None:
+    logger = get_logger()
+    logger.setLevel(_LEVELS.get(level.lower(), logging.WARNING))
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        logger.addHandler(handler)
+        logger.propagate = False
+    fmt = "[%(levelname)s] %(name)s: %(message)s" if hide_timestamp else \
+        "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
+    for handler in logger.handlers:
+        handler.setFormatter(logging.Formatter(fmt))

@@ -1,0 +1,32 @@
+"""Test rig: 8 virtual CPU devices.
+
+This is the TPU-native analogue of the reference's ``horovodrun -np 2 pytest``
+multi-process rig (SURVEY §4): ``--xla_force_host_platform_device_count=8``
+gives 8 collective participants in-process.
+
+Platform forcing must happen before any JAX backend initializes; the dev
+image pins an ``axon`` TPU platform via sitecustomize, so we override with
+``jax.config`` (which wins as long as no backend has been touched yet).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def hvd_session():
+    import horovod_tpu as hvd
+    hvd.init()
+    assert hvd.size() == 8, f"expected 8 fake devices, got {hvd.size()}"
+    yield
+    hvd.shutdown()

@@ -1,0 +1,84 @@
+"""Basics API: init/rank/size + config surfaces.
+
+Mirrors † ``test/parallel/test_torch.py`` rank/size assertions and
+† ``test/single/test_run.py`` config parsing style.
+"""
+
+import os
+
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import config as config_mod
+
+
+def test_initialized_and_sizes():
+    assert hvd.is_initialized()
+    assert hvd.size() == 8
+    assert hvd.rank() == 0          # single process drives device 0
+    assert hvd.local_size() == 8
+    assert hvd.cross_rank() == 0
+    assert hvd.cross_size() == 1
+
+
+def test_double_init_is_noop():
+    hvd.init()
+    assert hvd.size() == 8
+
+
+def test_mesh_shape():
+    m = hvd.mesh()
+    assert m.shape["hvd"] == 8
+
+
+def test_not_initialized_error():
+    # A fresh error type check without tearing down the session engine:
+    with pytest.raises(hvd.NotInitializedError):
+        raise hvd.NotInitializedError()
+
+
+def test_config_env_parsing(monkeypatch):
+    monkeypatch.setenv("HVDTPU_FUSION_THRESHOLD", "1048576")
+    monkeypatch.setenv("HOROVOD_CYCLE_TIME", "2.5")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    monkeypatch.setenv("HVDTPU_LOG_LEVEL", "debug")
+    cfg = config_mod.from_env()
+    assert cfg.fusion_threshold == 1048576
+    assert cfg.cycle_time_ms == 2.5
+    assert cfg.autotune is True
+    assert cfg.log_level == "debug"
+
+
+def test_config_env_precedence(monkeypatch):
+    # HVDTPU_ wins over HOROVOD_ when both are set.
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "111")
+    monkeypatch.setenv("HVDTPU_FUSION_THRESHOLD", "222")
+    assert config_mod.from_env().fusion_threshold == 222
+
+
+def test_config_bad_env(monkeypatch):
+    monkeypatch.setenv("HVDTPU_FUSION_THRESHOLD", "not-a-number")
+    with pytest.raises(ValueError):
+        config_mod.from_env()
+
+
+def test_config_yaml(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text(
+        "# comment\n"
+        "fusion_threshold: 2097152\n"
+        "cycle-time-ms: 7.5\n"
+        "autotune: true\n"
+        "log_level: info\n")
+    cfg = config_mod.from_yaml(str(p))
+    assert cfg.fusion_threshold == 2097152
+    assert cfg.cycle_time_ms == 7.5
+    assert cfg.autotune is True
+    assert cfg.log_level == "info"
+
+
+def test_config_yaml_unknown_key(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("no_such_knob: 1\n")
+    with pytest.raises(ValueError):
+        config_mod.from_yaml(str(p))

@@ -1,0 +1,134 @@
+"""Async engine: handles, fusion, error propagation, process sets.
+
+Mirrors † ``test/parallel/test_torch.py`` async tests
+(``test_horovod_allreduce_async_fused``, duplicate-name errors) and the
+fusion-of-many-small-tensors cases.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+N = 8
+
+
+def test_async_allreduce_roundtrip():
+    x = hvd.per_rank([np.full((4,), float(r), np.float32) for r in range(N)])
+    h = hvd.allreduce_async(x, hvd.Average, name="t.async1")
+    out = hvd.synchronize(h)
+    np.testing.assert_allclose(hvd.to_numpy(out), np.full((4,), 3.5))
+    assert hvd.poll(h)
+
+
+def test_async_many_fused():
+    handles = []
+    expected = []
+    for i in range(20):
+        parts = [np.full((5,), float(r + i), np.float32) for r in range(N)]
+        expected.append(np.stack(parts).mean(0))
+        handles.append(hvd.allreduce_async(hvd.per_rank(parts),
+                                           name=f"t.fused.{i}"))
+    for h, exp in zip(handles, expected):
+        np.testing.assert_allclose(hvd.to_numpy(hvd.synchronize(h)), exp,
+                                   rtol=1e-6)
+
+
+def test_async_mixed_verbs():
+    x = hvd.per_rank([np.full((2,), float(r), np.float32) for r in range(N)])
+    h1 = hvd.allreduce_async(x, hvd.Sum, name="t.mix.ar")
+    h2 = hvd.broadcast_async(x, 2, name="t.mix.bc")
+    h3 = hvd.allgather_async(x, name="t.mix.ag")
+    np.testing.assert_allclose(hvd.to_numpy(hvd.synchronize(h1)),
+                               np.full((2,), 28.0))
+    np.testing.assert_allclose(hvd.to_numpy(hvd.synchronize(h2)),
+                               np.full((2,), 2.0))
+    assert hvd.synchronize(h3).shape == (N * 2,)
+
+
+def test_duplicate_name_rejected():
+    # Pause the engine so both enqueues are observably in-flight together
+    # (otherwise the 5 ms cycle could drain h1 before h2 arrives).
+    eng = hvd.global_state().engine
+    x = hvd.per_rank([np.zeros((10,), np.float32)] * N)
+    eng.pause()
+    try:
+        h1 = hvd.allreduce_async(x, name="t.dup")
+        h2 = hvd.allreduce_async(x, name="t.dup")
+    finally:
+        eng.resume()
+    with pytest.raises(hvd.HorovodInternalError):
+        hvd.synchronize(h2)
+    hvd.synchronize(h1)
+
+
+def test_error_propagates_to_handle():
+    x = hvd.per_rank([np.zeros((5,), np.float32)] * N)
+    h = hvd.alltoall_async(x, name="t.err")   # 5 rows not divisible by 8
+    with pytest.raises(hvd.HorovodInternalError):
+        hvd.synchronize(h)
+
+
+def test_engine_cycles_advance():
+    eng = hvd.global_state().engine
+    c0 = eng.cycle_count
+    x = hvd.per_rank([np.ones((2,), np.float32)] * N)
+    hvd.synchronize(hvd.allreduce_async(x, name="t.cycle"))
+    time.sleep(0.05)
+    assert eng.cycle_count > c0
+
+
+def test_fusion_respects_threshold():
+    # Two tensors whose combined size exceeds a tiny threshold must split
+    # into separate dispatch groups but still both complete correctly.
+    state = hvd.global_state()
+    old = state.config.fusion_threshold
+    state.config.fusion_threshold = 4 * 10  # 10 floats
+    try:
+        xs = [hvd.per_rank([np.full((8,), float(r + i), np.float32)
+                            for r in range(N)]) for i in range(4)]
+        hs = [hvd.allreduce_async(x, hvd.Sum, name=f"t.thresh.{i}")
+              for i, x in enumerate(xs)]
+        for i, h in enumerate(hs):
+            exp = np.full((8,), sum(range(N)) + N * i, np.float32)
+            np.testing.assert_allclose(hvd.to_numpy(hvd.synchronize(h)), exp)
+    finally:
+        state.config.fusion_threshold = old
+
+
+def test_process_set_allreduce():
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    parts = [np.full((3,), float(r), np.float32) for r in (0, 2, 4, 6)]
+    x = hvd.per_rank(parts, process_set=ps)
+    out = hvd.to_numpy(hvd.allreduce(x, hvd.Sum, process_set=ps))
+    np.testing.assert_allclose(out, np.full((3,), 12.0))
+    assert ps.size() == 4
+    assert ps.rank_of(4) == 2
+    assert not ps.included(1)
+    hvd.remove_process_set(ps)
+
+
+def test_process_set_async():
+    ps = hvd.add_process_set([1, 3])
+    x = hvd.per_rank([np.full((2,), 1.0, np.float32),
+                      np.full((2,), 3.0, np.float32)], process_set=ps)
+    h = hvd.allreduce_async(x, hvd.Average, name="t.ps", process_set=ps)
+    np.testing.assert_allclose(hvd.to_numpy(hvd.synchronize(h)),
+                               np.full((2,), 2.0))
+    hvd.remove_process_set(ps)
+
+
+def test_timeline_writes_events(tmp_path):
+    from horovod_tpu.utils.timeline import Timeline
+    p = tmp_path / "tl.json"
+    tl = Timeline(str(p), mark_cycles=True)
+    tl.start_activity("tensor.a", "DISPATCH")
+    tl.end_activity("tensor.a")
+    tl.mark_cycle()
+    tl.close()
+    import json
+    events = json.load(open(p))
+    names = [e.get("name") for e in events]
+    assert "DISPATCH" in names and "CYCLE" in names
