@@ -1,0 +1,85 @@
+"""Benchmark harness: prints ONE JSON line for the driver.
+
+Measures flagship (Llama-family) training-step throughput in tokens/sec on
+the available hardware.  ``vs_baseline`` compares against the recorded
+baseline for the same platform in ``BENCH_BASELINE`` below (first-round
+value measured on this repo's TPU v5-lite dev chip; the reference's own
+published numbers are GPU-cluster scaling efficiencies — see BASELINE.md —
+with no single-chip figure to compare against, so the stored first
+measurement is the regression anchor).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# tokens/sec anchors per platform (measured at round 1 on TPU v5-lite).
+BENCH_BASELINE = {
+    "tpu": 57800.0,
+    "cpu": 2000.0,
+}
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.models import llama
+    from horovod_tpu.parallel import MeshConfig, build_mesh
+
+    backend = jax.default_backend()
+    n_dev = len(jax.devices())
+
+    if backend == "tpu":
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=16, d_ff=4096, remat=False)
+        B, S = 8, 1024
+        steps, warmup = 20, 3
+    else:
+        cfg = llama.LlamaConfig.tiny(d_model=128, n_layers=2, n_heads=4,
+                                     n_kv_heads=4, d_ff=256)
+        B, S = 8, 128
+        steps, warmup = 5, 2
+
+    mesh = build_mesh(MeshConfig(dp=n_dev))
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), mesh)
+    tx = optax.adam(1e-4)
+    opt_state = jax.jit(tx.init)(params)
+    step = llama.make_train_step(cfg, mesh, tx)
+
+    tokens = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(B * n_dev, S + 1))
+    batch = jax.device_put({"tokens": jnp.asarray(tokens, jnp.int32)},
+                           NamedSharding(mesh, P(("dp", "fsdp"))))
+
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, batch)
+    float(loss)  # host fetch: block_until_ready alone can be a no-op on
+    # tunneled backends, so force a device->host readback to fence.
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+    final_loss = float(loss)
+    elapsed = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
+
+    tokens_per_sec = B * n_dev * S * steps / elapsed
+    per_chip = tokens_per_sec / n_dev
+    base = BENCH_BASELINE.get(backend, per_chip)
+    print(json.dumps({
+        "metric": f"llama_train_tokens_per_sec_per_chip_{backend}",
+        "value": round(per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(per_chip / base, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,320 @@
+"""Llama-family transformer — the flagship model (BASELINE config 4).
+
+The reference has no model engine (Horovod is a collective layer; its Llama
+story would be "bring your own torch model"), so this is built TPU-first:
+
+- **Layout**: params carry logical dimension names mapped to mesh axes by
+  :mod:`horovod_tpu.parallel.sharding` — Megatron-style tp on heads/mlp,
+  fsdp (ZeRO-3) on the embed dim at rest, layer stack over pp, experts over
+  ep.  GSPMD inserts the tp/fsdp collectives; explicit ``shard_map`` blocks
+  handle the two patterns compilers don't infer well: ring attention over sp
+  and MoE dispatch over ep.
+- **Compute**: bfloat16 activations/weights with fp32 RMSNorm/softmax/loss
+  accumulation (MXU-native mix); RoPE; GQA; SwiGLU; optional Switch-MoE MLP.
+- **Control flow**: one ``lax.scan`` over stacked layer params (single
+  compiled layer body; compile time independent of depth) with
+  ``jax.checkpoint`` rematerialization per layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel import sharding as shd
+from ..parallel.moe import moe_layer_local
+from ..parallel.ring_attention import ring_attention_local
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    use_moe: bool = False
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    remat: bool = True
+    moe_aux_weight: float = 0.01
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Test-scale config (fast CPU compile)."""
+        base = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=128, dtype=jnp.float32, remat=False)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        base = dict(vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+                    n_kv_heads=32, d_ff=11008)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+# Logical dims for every parameter (leaf-name -> dims); layer-stacked leaves
+# get a leading "stage" dim (mapped to pp).
+def param_logical_dims(cfg: LlamaConfig) -> dict:
+    layer = {
+        "attn_norm": ("stage", None),
+        "wq": ("stage", "embed", "heads", "head_dim"),
+        "wk": ("stage", "embed", "kv_heads", "head_dim"),
+        "wv": ("stage", "embed", "kv_heads", "head_dim"),
+        "wo": ("stage", "heads", "head_dim", "embed"),
+        "mlp_norm": ("stage", None),
+    }
+    if cfg.use_moe:
+        layer.update({
+            "router": ("stage", None, None),
+            "w_gate": ("stage", "experts", "embed", "expert_mlp"),
+            "w_up": ("stage", "experts", "embed", "expert_mlp"),
+            "w_down": ("stage", "experts", "expert_mlp", "embed"),
+        })
+    else:
+        layer.update({
+            "w_gate": ("stage", "embed", "mlp"),
+            "w_up": ("stage", "embed", "mlp"),
+            "w_down": ("stage", "mlp", "embed"),
+        })
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": layer,
+        "final_norm": (None,),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> dict:
+    return jax.tree.map(
+        lambda dims: shd.logical_sharding(mesh, dims),
+        param_logical_dims(cfg),
+        is_leaf=lambda x: isinstance(x, tuple))
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array, mesh: Optional[Mesh] = None
+                ) -> dict:
+    """Initialize parameters, sharded per the logical rules when a mesh is
+    given (init runs jitted with out_shardings so full weights never
+    materialize on one device)."""
+    L, D, H, KV, Dh, F = (cfg.n_layers, cfg.d_model, cfg.n_heads,
+                          cfg.n_kv_heads, cfg.head_dim, cfg.d_ff)
+
+    def build(key):
+        ks = jax.random.split(key, 12)
+        scale = lambda fan_in: 1.0 / np.sqrt(fan_in)
+        norm = lambda shape: jnp.ones(shape, jnp.float32)
+        rnd = lambda k, shape, fan: (
+            jax.random.normal(k, shape, jnp.float32) * scale(fan)
+        ).astype(cfg.dtype)
+        layers = {
+            "attn_norm": norm((L, D)),
+            "wq": rnd(ks[0], (L, D, H, Dh), D),
+            "wk": rnd(ks[1], (L, D, KV, Dh), D),
+            "wv": rnd(ks[2], (L, D, KV, Dh), D),
+            "wo": rnd(ks[3], (L, H, Dh, D), H * Dh),
+            "mlp_norm": norm((L, D)),
+        }
+        if cfg.use_moe:
+            E = cfg.n_experts
+            layers.update({
+                "router": rnd(ks[4], (L, D, E), D).astype(jnp.float32),
+                "w_gate": rnd(ks[5], (L, E, D, F), D),
+                "w_up": rnd(ks[6], (L, E, D, F), D),
+                "w_down": rnd(ks[7], (L, E, F, D), F),
+            })
+        else:
+            layers.update({
+                "w_gate": rnd(ks[5], (L, D, F), D),
+                "w_up": rnd(ks[6], (L, D, F), D),
+                "w_down": rnd(ks[7], (L, F, D), F),
+            })
+        return {
+            "embed": rnd(ks[8], (cfg.vocab_size, D), D),
+            "layers": layers,
+            "final_norm": jnp.ones((D,), jnp.float32),
+            "lm_head": rnd(ks[9], (D, cfg.vocab_size), D),
+        }
+
+    if mesh is None:
+        return build(key)
+    shardings = param_shardings(cfg, mesh)
+    return jax.jit(build, out_shardings=shardings)(key)
+
+
+def _rmsnorm(x: jax.Array, w: jax.Array, eps: float = 1e-5) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms * w).astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    # x: [B, S, H, Dh]; positions: [B, S]
+    B, S, H, Dh = x.shape
+    half = Dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(q, k, v, mesh: Optional[Mesh], causal: bool) -> jax.Array:
+    """Dispatch dense vs ring attention by the mesh's sp size."""
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    if sp > 1:
+        fn = shard_map(
+            partial(ring_attention_local, axis_name="sp", causal=causal),
+            mesh=mesh,
+            in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+            out_specs=P(None, "sp"),
+            axis_names={"sp"},
+            check_vma=False)
+        return fn(q, k, v)
+    D = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D)
+    if causal:
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def _moe_mlp(h2, lp, cfg: LlamaConfig, mesh: Optional[Mesh]):
+    """Switch-MoE MLP: SwiGLU experts over the ep axis."""
+    B, S, D = h2.shape
+    flat = h2.reshape(B * S, D)
+
+    def expert_fn(w, x):
+        # w: dict leaves for ONE expert; x: [cap, D]
+        g = jax.nn.silu(x @ w["w_gate"])
+        u = x @ w["w_up"]
+        return (g * u) @ w["w_down"]
+
+    eparams = {"w_gate": lp["w_gate"], "w_up": lp["w_up"],
+               "w_down": lp["w_down"]}
+    ep = mesh.shape.get("ep", 1) if mesh is not None else 1
+    if ep > 1:
+        fn = shard_map(
+            lambda tok, rk, pr: moe_layer_local(
+                tok, rk, expert_fn, pr, axis_name="ep",
+                capacity_factor=cfg.capacity_factor),
+            mesh=mesh,
+            in_specs=(P("ep"), P(), P("ep")),
+            out_specs=(P("ep"), P()),
+            axis_names={"ep"},
+            check_vma=False)
+        out, aux = fn(flat, lp["router"].astype(jnp.float32), eparams)
+    else:
+        # Single expert group: same math without the exchange.
+        from ..parallel.moe import switch_route
+        E = cfg.n_experts
+        cap = max(1, int(flat.shape[0] * cfg.capacity_factor / E))
+        logits = flat.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
+        dispatch, combine, aux = switch_route(logits, cap)
+        einputs = jnp.einsum("tec,td->ecd", dispatch.astype(flat.dtype), flat)
+        eouts = jax.vmap(expert_fn)(eparams, einputs)
+        out = jnp.einsum("tec,ecd->td", combine.astype(flat.dtype), eouts)
+    return out.reshape(B, S, D), aux
+
+
+def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, *,
+            mesh: Optional[Mesh] = None, causal: bool = True
+            ) -> tuple[jax.Array, jax.Array]:
+    """Logits for next-token prediction.  Returns (logits, moe_aux_loss)."""
+    B, S = tokens.shape
+    h = params["embed"].astype(cfg.dtype)[tokens]           # [B,S,D]
+    h = shd.constrain(h, ("batch", "seq", None), mesh) if mesh else h
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+
+    def layer_body(carry, lp):
+        h, aux = carry
+        # -- attention --
+        x = _rmsnorm(h, lp["attn_norm"])
+        q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"])
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        if cfg.n_kv_heads != cfg.n_heads:                  # GQA expand
+            rep = cfg.n_heads // cfg.n_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        attn = _attention(q, k, v, mesh, causal)
+        h = h + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+        # -- mlp --
+        x2 = _rmsnorm(h, lp["mlp_norm"])
+        if cfg.use_moe:
+            mlp_out, moe_aux = _moe_mlp(x2, lp, cfg, mesh)
+            aux = aux + moe_aux
+        else:
+            g = jax.nn.silu(jnp.einsum("bsd,df->bsf", x2, lp["w_gate"]))
+            u = jnp.einsum("bsd,df->bsf", x2, lp["w_up"])
+            mlp_out = jnp.einsum("bsf,fd->bsd", g * u, lp["w_down"])
+        h = h + mlp_out
+        if mesh is not None:
+            h = shd.constrain(h, ("batch", "seq", None), mesh)
+        return (h, aux), None
+
+    body = layer_body
+    if cfg.remat:
+        body = jax.checkpoint(layer_body)
+    (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
+                           params["layers"])
+    h = _rmsnorm(h, params["final_norm"])
+    logits = jnp.einsum("bsd,dv->bsv", h, params["lm_head"])
+    if mesh is not None:
+        logits = shd.constrain(logits, ("batch", "seq", "vocab"), mesh)
+    return logits.astype(jnp.float32), aux
+
+
+def loss_fn(params: dict, batch: dict, cfg: LlamaConfig, *,
+            mesh: Optional[Mesh] = None) -> jax.Array:
+    """Causal LM loss: batch = {"tokens": [B,S+1] int32}."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits, aux = forward(params, inputs, cfg, mesh=mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean() + cfg.moe_aux_weight * aux
+
+
+def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx):
+    """Jitted full training step over the mesh (GSPMD collectives for
+    dp/fsdp/tp, explicit shard_map blocks for sp/ep; layer stack over pp)."""
+    pshard = param_shardings(cfg, mesh)
+    repl = NamedSharding(mesh, P())
+    batch_shard = NamedSharding(mesh, P(("dp", "fsdp")))
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, cfg, mesh=mesh))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree.map(jnp.add, params, updates)
+        return params, opt_state, loss
+
+    opt_shard = None  # inferred
+    return jax.jit(
+        step,
+        in_shardings=(pshard, opt_shard, batch_shard),
+        out_shardings=(pshard, opt_shard, repl),
+        donate_argnums=(0, 1))

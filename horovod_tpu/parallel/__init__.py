@@ -1,0 +1,21 @@
+"""Parallelism strategies beyond the reference.
+
+The reference is data-parallel only (SURVEY §2.6: TP/PP/SP/EP all ABSENT —
+Horovod scales batch, never model or sequence).  On TPU, the same collective
+layer that carries DP gradients (ICI psum) also carries tensor-parallel
+activations, ring-attention KV rotation, pipeline hand-offs and MoE dispatch,
+so this package makes every strategy first-class:
+
+- :mod:`mesh` — multi-axis device meshes (dp/fsdp/tp/sp/pp/ep) with
+  ICI-friendly axis ordering; hierarchical = ICI within slice, DCN across.
+- :mod:`sharding` — logical-axis → PartitionSpec rules (GSPMD annotations).
+- :mod:`tensor_parallel` — Megatron-style column/row-parallel layers.
+- :mod:`ring_attention` — sequence parallelism via blockwise KV rotation
+  (``ppermute`` ring) with online-softmax accumulation.
+- :mod:`pipeline` — GPipe-style microbatch pipelining over the pp axis.
+- :mod:`moe` — expert parallelism: top-k gating + ``all_to_all`` dispatch,
+  the DLRM/MoE use of the alltoall verb (BASELINE config 5).
+"""
+
+from .mesh import MeshConfig, build_mesh  # noqa: F401
+from .sharding import logical_sharding, constrain  # noqa: F401

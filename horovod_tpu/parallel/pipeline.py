@@ -1,0 +1,100 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over the pp axis.
+
+ABSENT in the reference (SURVEY §2.6).  TPU-native design: stage parameters
+are stacked along a leading ``[pp, ...]`` dimension sharded over the ``pp``
+mesh axis; inside ``shard_map`` every device runs the *same* program (SPMD)
+and hands activations to its successor with ``ppermute`` — the point-to-point
+collective that tolerates DCN, which is why pp is the outermost mesh axis
+(see :mod:`horovod_tpu.parallel.mesh`).
+
+Schedule: GPipe fill-drain with M microbatches over S stages: T = M + S - 1
+ticks.  At tick t, the device at stage s processes microbatch ``t - s`` when
+``0 <= t - s < M`` and garbage otherwise (masked out).  Bubble fraction
+(S-1)/(M+S-1) — callers pick M >= 4·S to keep it small.  The tick loop is a
+``lax.scan`` (compiler-friendly control flow; one compiled body regardless
+of M).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply_local(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                         stage_params: Any,
+                         microbatches: jax.Array, *,
+                         axis_name: str = "pp") -> jax.Array:
+    """Run the pipeline inside a mapped context.
+
+    ``stage_params``: this device's stage parameters (leading pp dim already
+    stripped to local, i.e. leaves are one stage's params with a leading
+    singleton removed by the caller's in_specs).
+    ``microbatches``: [M, mb, ...] — the full microbatch set, replicated
+    across pp (each stage only *uses* its inputs when scheduled).
+    Returns [M, mb, ...] outputs, valid on the last stage.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    T = M + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # Stage 0 injects microbatch t (when in range); others take the
+        # activation handed over from the previous stage.
+        mb_idx = jnp.clip(t, 0, M - 1)
+        injected = microbatches[mb_idx]
+        x = jnp.where(idx == 0, injected, buf)
+        y = stage_fn(stage_params, x)
+        # The last stage records its result for microbatch t - (n-1).
+        out_idx = jnp.clip(t - (n - 1), 0, M - 1)
+        is_valid = (t - (n - 1) >= 0) & (t - (n - 1) < M)
+        record = jnp.where((idx == n - 1) & is_valid, 1.0, 0.0)
+        outputs = outputs.at[out_idx].set(
+            jnp.where(record > 0, y, outputs[out_idx]))
+        # Hand activations downstream (ring; stage n-1 → 0 is ignored).
+        buf = lax.ppermute(y, axis_name, perm)
+        return (buf, outputs), None
+
+    buf0 = jnp.zeros_like(microbatches[0])
+    out0 = jnp.zeros(microbatches.shape[:1] + _out_shape(
+        stage_fn, stage_params, microbatches[0]), microbatches.dtype)
+    (_, outputs), _ = lax.scan(tick, (buf0, out0), jnp.arange(T))
+    # Broadcast final outputs from the last stage to all pp ranks so the
+    # caller sees replicated results (one psum, masked).
+    outputs = lax.psum(
+        jnp.where(idx == n - 1, outputs, jnp.zeros_like(outputs)), axis_name)
+    return outputs
+
+
+def _out_shape(stage_fn, params, x) -> tuple[int, ...]:
+    return jax.eval_shape(stage_fn, params, x).shape
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stacked_params: Any,
+                   microbatches: jax.Array,
+                   mesh: Mesh, *,
+                   axis_name: str = "pp") -> jax.Array:
+    """Standalone entry: ``stacked_params`` leaves have leading dim = pp size
+    (stage-major), sharded over ``axis_name``; ``microbatches`` is [M, mb,...]
+    replicated.  Returns [M, mb, ...] outputs replicated."""
+
+    def local(params, mb):
+        local_params = jax.tree.map(lambda a: a[0], params)
+        return pipeline_apply_local(stage_fn, local_params, mb,
+                                    axis_name=axis_name)
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        check_vma=False)
+    return jax.jit(fn)(stacked_params, microbatches)

@@ -1,0 +1,63 @@
+"""Logical-axis sharding rules (the GSPMD annotation layer).
+
+The scaling recipe: name every tensor dimension logically (``batch``,
+``seq``, ``embed``, ``mlp``, ``heads``, ``experts``, ``stage``…), map logical
+names to mesh axes once, annotate with ``with_sharding_constraint``, and let
+XLA insert the collectives.  This module owns that one mapping so models
+never hard-code mesh axes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical dimension name -> mesh axis (or tuple of axes) it shards over
+DEFAULT_RULES: dict[str, Union[None, str, tuple[str, ...]]] = {
+    "batch": ("dp", "fsdp"),   # data-parallel batch split
+    "seq": "sp",               # sequence/context parallel
+    "embed": "fsdp",           # ZeRO-3: params sharded over fsdp at rest
+    "mlp": "tp",               # column-parallel hidden dim
+    "heads": "tp",             # attention heads over tp
+    "kv_heads": "tp",
+    "head_dim": None,
+    "qkv": None,
+    "vocab": "tp",             # output projection vocab-parallel
+    "experts": "ep",           # MoE experts over ep
+    "expert_mlp": "tp",
+    "stage": "pp",             # pipeline stage dimension (stacked params)
+    "norm": None,
+}
+
+
+def spec_for(logical_dims: Sequence[Optional[str]],
+             rules: Optional[dict] = None) -> P:
+    """PartitionSpec for a tensor whose dims have these logical names."""
+    rules = {**DEFAULT_RULES, **(rules or {})}
+    entries = []
+    for dim in logical_dims:
+        if dim is None:
+            entries.append(None)
+            continue
+        if dim not in rules:
+            raise KeyError(f"unknown logical dim {dim!r}")
+        entries.append(rules[dim])
+    return P(*entries)
+
+
+def logical_sharding(mesh: Mesh, logical_dims: Sequence[Optional[str]],
+                     rules: Optional[dict] = None) -> NamedSharding:
+    return NamedSharding(mesh, spec_for(logical_dims, rules))
+
+
+def constrain(x: jax.Array, logical_dims: Sequence[Optional[str]],
+              mesh: Optional[Mesh] = None,
+              rules: Optional[dict] = None) -> jax.Array:
+    """``with_sharding_constraint`` by logical dimension names."""
+    spec = spec_for(logical_dims, rules)
+    if mesh is not None:
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec))
+    return jax.lax.with_sharding_constraint(x, spec)
