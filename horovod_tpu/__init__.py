@@ -58,17 +58,12 @@ from .ops import (  # noqa: F401
     Max,
     Product,
     Adasum,
-    allreduce,
     grouped_allreduce,
-    allgather,
-    broadcast,
-    alltoall,
-    reducescatter,
-    barrier,
     per_rank,
     per_rank_from_fn,
     to_numpy,
 )
+from .ops.collectives import from_local, to_local  # noqa: F401
 from .ops.engine import Handle, HorovodInternalError, TensorTableEntry
 from .ops import collectives as _C
 
@@ -87,6 +82,98 @@ def _engine():
     if not state.initialized or state.engine is None:
         raise NotInitializedError()
     return state.engine
+
+
+# ---------------------------------------------------------------------------
+# Synchronous verbs.
+#
+# Single-process: direct compiled dispatch (lowest latency).  Multi-process:
+# routed through the engine so the coordinator orders them against
+# concurrent async traffic — mixing un-negotiated dispatches with negotiated
+# ones could interleave differently across processes and deadlock the
+# device queues (the exact failure Horovod's coordinator exists to prevent).
+# ---------------------------------------------------------------------------
+
+def _sync_via_engine_or_direct(direct_fn, verb: str, payload: Any,
+                               **entry_kw) -> Any:
+    state = global_state()
+    if state.initialized and state.engine is not None \
+            and state.engine.distributed:
+        entry = TensorTableEntry(
+            name=_auto_name(verb, None), verb=verb, payload=payload,
+            **entry_kw)
+        handle = state.engine.enqueue(entry, urgent=True)
+        return handle.wait()
+    return direct_fn()
+
+
+def allreduce(x: Any, op: ReduceOp = Average, *,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set=None) -> Any:
+    """Reduce a per-rank tensor across ranks; result replicated
+    († ``hvd.allreduce``)."""
+    payload = _C.as_per_rank(x, process_set)
+    return _sync_via_engine_or_direct(
+        lambda: _C.allreduce(payload, op, prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor,
+                             process_set=process_set),
+        "allreduce", payload, op=op, prescale=prescale_factor,
+        postscale=postscale_factor, process_set=process_set)
+
+
+def allgather(x: Any, process_set=None) -> Any:
+    """Concatenate per-rank tensors along dim 0 († ``hvd.allgather``)."""
+    payload = x if isinstance(x, (list, tuple)) else \
+        _C.as_per_rank(x, process_set)
+    return _sync_via_engine_or_direct(
+        lambda: _C.allgather(payload, process_set=process_set),
+        "allgather", payload, process_set=process_set)
+
+
+def broadcast(x: Any, root_rank: int, process_set=None) -> Any:
+    """Every rank receives root's tensor († ``hvd.broadcast``)."""
+    payload = _C.as_per_rank(x, process_set)
+    return _sync_via_engine_or_direct(
+        lambda: _C.broadcast(payload, root_rank, process_set=process_set),
+        "broadcast", payload, root_rank=root_rank, process_set=process_set)
+
+
+def alltoall(x: Any, splits: Optional[Sequence[int]] = None,
+             process_set=None) -> Any:
+    """Scatter dim-0 slices of each rank's tensor to all ranks
+    († ``hvd.alltoall``)."""
+    payload = _C.as_per_rank(x, process_set)
+    return _sync_via_engine_or_direct(
+        lambda: _C.alltoall(payload, splits, process_set=process_set),
+        "alltoall", payload, splits=splits, process_set=process_set)
+
+
+def reducescatter(x: Any, op: ReduceOp = Sum, process_set=None) -> Any:
+    """Reduce then scatter dim-0 slices across ranks."""
+    payload = _C.as_per_rank(x, process_set)
+    return _sync_via_engine_or_direct(
+        lambda: _C.reducescatter(payload, op, process_set=process_set),
+        "reducescatter", payload, op=op, process_set=process_set)
+
+
+def barrier(process_set=None) -> None:
+    """Block until all ranks arrive († ``hvd.barrier``)."""
+    import numpy as _np
+    state = global_state()
+    if state.initialized and state.engine is not None \
+            and state.engine.distributed:
+        n = process_set.size() if process_set is not None else size()
+        ones = _C.from_local(
+            _np.ones((local_size(), ), _np.int32)[:, None], process_set)
+        entry = TensorTableEntry(
+            name=_auto_name("barrier", None), verb="allreduce",
+            payload=ones, op=Sum, process_set=process_set)
+        result = state.engine.enqueue(entry, urgent=True).wait()
+        total = int(_C.to_numpy(result)[0])
+        if total != n:
+            raise RuntimeError(f"barrier allreduce returned {total} != {n}")
+        return
+    _C.barrier(process_set)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,205 @@
+"""ctypes bindings to the native core (``native/libhvdtpu_core.so``).
+
+† ``horovod/common/basics.py`` loads the built extension via ctypes the same
+way.  The library is built on demand with ``make -C native`` if missing
+(dev convenience; packaged builds ship the .so).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libhvdtpu_core.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            subprocess.run(["make", "-C", _NATIVE_DIR],
+                           check=True, capture_output=True)
+        lib = ctypes.CDLL(_SO_PATH)
+        # KV store
+        lib.hvd_kv_server_start.restype = ctypes.c_void_p
+        lib.hvd_kv_server_start.argtypes = [ctypes.c_int]
+        lib.hvd_kv_server_port.restype = ctypes.c_int
+        lib.hvd_kv_server_port.argtypes = [ctypes.c_void_p]
+        lib.hvd_kv_server_stop.argtypes = [ctypes.c_void_p]
+        lib.hvd_kv_connect.restype = ctypes.c_void_p
+        lib.hvd_kv_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.c_int]
+        lib.hvd_kv_set.restype = ctypes.c_int
+        lib.hvd_kv_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_char_p, ctypes.c_int]
+        lib.hvd_kv_wait.restype = ctypes.c_int
+        lib.hvd_kv_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int, ctypes.c_char_p,
+                                    ctypes.c_int]
+        lib.hvd_kv_del.restype = ctypes.c_int
+        lib.hvd_kv_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.hvd_kv_close.argtypes = [ctypes.c_void_p]
+        # Controller
+        lib.hvd_ctrl_server_start.restype = ctypes.c_void_p
+        lib.hvd_ctrl_server_start.argtypes = [ctypes.c_int, ctypes.c_int,
+                                              ctypes.c_int]
+        lib.hvd_ctrl_server_port.restype = ctypes.c_int
+        lib.hvd_ctrl_server_port.argtypes = [ctypes.c_void_p]
+        lib.hvd_ctrl_server_stop.argtypes = [ctypes.c_void_p]
+        lib.hvd_ctrl_connect.restype = ctypes.c_void_p
+        lib.hvd_ctrl_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                         ctypes.c_int, ctypes.c_int]
+        lib.hvd_ctrl_negotiate.restype = ctypes.c_int
+        lib.hvd_ctrl_negotiate.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           ctypes.c_char_p, ctypes.c_int]
+        lib.hvd_ctrl_cache_size.restype = ctypes.c_int
+        lib.hvd_ctrl_cache_size.argtypes = [ctypes.c_void_p]
+        lib.hvd_ctrl_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class KvServer:
+    """Rendezvous KV store server († Gloo ``RendezvousServer``)."""
+
+    def __init__(self, port: int = 0) -> None:
+        self._lib = load()
+        self._h = self._lib.hvd_kv_server_start(port)
+        if not self._h:
+            raise OSError(f"failed to start KV server on port {port}")
+
+    @property
+    def port(self) -> int:
+        return self._lib.hvd_kv_server_port(self._h)
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.hvd_kv_server_stop(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class KvClient:
+    """† ``gloo/http_store.cc`` client role."""
+
+    def __init__(self, host: str, port: int, timeout_ms: int = 10000) -> None:
+        self._lib = load()
+        self._h = self._lib.hvd_kv_connect(host.encode(), port, timeout_ms)
+        if not self._h:
+            raise ConnectionError(f"cannot reach KV server {host}:{port}")
+
+    def set(self, key: str, value: bytes) -> None:
+        if self._lib.hvd_kv_set(self._h, key.encode(), value, len(value)) != 0:
+            raise OSError(f"kv set failed for {key!r}")
+
+    def wait(self, key: str, timeout_ms: int = 10000) -> bytes:
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = self._lib.hvd_kv_wait(self._h, key.encode(), timeout_ms, buf,
+                                  len(buf))
+        if n < 0:
+            raise TimeoutError(f"key {key!r} not set within {timeout_ms}ms")
+        if n > len(buf):
+            buf = ctypes.create_string_buffer(n)
+            n = self._lib.hvd_kv_wait(self._h, key.encode(), 0, buf, n)
+            if n < 0:
+                raise TimeoutError(f"key {key!r} disappeared")
+        return buf.raw[:n]
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            return self.wait(key, timeout_ms=0)
+        except TimeoutError:
+            return None
+
+    def delete(self, key: str) -> None:
+        self._lib.hvd_kv_del(self._h, key.encode())
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_kv_close(self._h)
+            self._h = None
+
+
+class ControllerServer:
+    """Rank-0 coordinator service († ``controller.cc``)."""
+
+    def __init__(self, size: int, port: int = 0,
+                 stall_warn_ms: int = 60000) -> None:
+        self._lib = load()
+        self._h = self._lib.hvd_ctrl_server_start(port, size, stall_warn_ms)
+        if not self._h:
+            raise OSError(f"failed to start controller on port {port}")
+
+    @property
+    def port(self) -> int:
+        return self._lib.hvd_ctrl_server_port(self._h)
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.hvd_ctrl_server_stop(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class ControllerClient:
+    """Per-rank negotiation client with the name→id response cache."""
+
+    def __init__(self, host: str, port: int, rank: int,
+                 timeout_ms: int = 10000) -> None:
+        self._lib = load()
+        self._h = self._lib.hvd_ctrl_connect(host.encode(), port, rank,
+                                             timeout_ms)
+        if not self._h:
+            raise ConnectionError(
+                f"cannot reach controller {host}:{port} (rank {rank})")
+
+    def negotiate(self, names: list[str], timeout_ms: int = 60000
+                  ) -> tuple[list[str], list[str]]:
+        """Submit newly-ready tensor names; block until the round completes.
+
+        Returns (globally_ready_ordered, stalled_warnings).
+        """
+        blob = "\n".join(names).encode()
+        cap = 1 << 20  # 1 MB of tensor names per round is far beyond real use
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.hvd_ctrl_negotiate(self._h, blob, buf, cap)
+        if n < 0:
+            raise ConnectionError("negotiation failed (controller gone?)")
+        if n > cap:
+            # A re-negotiate would start a new round; this is a hard limit.
+            raise RuntimeError(f"negotiation response {n} bytes exceeds cap")
+        payload = buf.raw[:n].decode()
+        ready_part, _, stalled_part = payload.partition("\x01")
+        ready = [s for s in ready_part.split("\n") if s]
+        stalled = [s for s in stalled_part.split("\n") if s]
+        return ready, stalled
+
+    @property
+    def cache_size(self) -> int:
+        return self._lib.hvd_ctrl_cache_size(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_ctrl_close(self._h)
+            self._h = None

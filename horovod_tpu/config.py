@@ -84,7 +84,9 @@ class Config:
     elastic: bool = False
 
     # --- coordination / rendezvous († gloo_context.cc reads of env) ---
-    coordinator_addr: Optional[str] = None  # host:port of the controller
+    coordinator_addr: Optional[str] = None  # host:port of JAX coordination svc
+    controller_addr: Optional[str] = None   # host:port of native coordinator
+    rendezvous_addr: Optional[str] = None   # host:port of KV store
     rank_env: Optional[int] = None
     size_env: Optional[int] = None
     local_rank_env: Optional[int] = None
@@ -120,6 +122,8 @@ _ENV_TABLE = [
     ("hierarchical_allgather", "HIERARCHICAL_ALLGATHER", _parse_bool),
     ("elastic", "ELASTIC", _parse_bool),
     ("coordinator_addr", "COORDINATOR_ADDR", str),
+    ("controller_addr", "CONTROLLER_ADDR", str),
+    ("rendezvous_addr", "RENDEZVOUS_ADDR", str),
     ("rank_env", "RANK", int),
     ("size_env", "SIZE", int),
     ("local_rank_env", "LOCAL_RANK", int),

@@ -139,7 +139,16 @@ def init(
         _state.timeline = Timeline(cfg.timeline, mark_cycles=cfg.timeline_mark_cycles)
 
         from .ops.engine import CollectiveEngine
-        _state.engine = CollectiveEngine(_state)
+        negotiator = None
+        if cfg.controller_addr and jax.process_count() > 1:
+            # Multi-process mode: engine cycles are coordinator-barriered so
+            # fused dispatch order is identical on every process
+            # († MPIController gather/bcast round).
+            from .ops.negotiator import DistributedNegotiator
+            host, _, port = cfg.controller_addr.rpartition(":")
+            negotiator = DistributedNegotiator(
+                host or "127.0.0.1", int(port), jax.process_index())
+        _state.engine = CollectiveEngine(_state, negotiator)
         _state.engine.start()
 
         from .ops.process_sets import ProcessSetTable

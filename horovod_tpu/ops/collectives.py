@@ -170,6 +170,41 @@ def to_numpy(x: jax.Array) -> np.ndarray:
     return np.asarray(jax.device_get(x))
 
 
+def from_local(x: Any, process_set=None) -> jax.Array:
+    """Build a per-rank tensor from this process's local shards (multi-host).
+
+    ``x``: host array of shape ``[local_ranks, *shape]`` — one row per device
+    this process drives, in mesh order.  Every process calls this with its
+    own rows and receives the same global ``[size, *shape]`` per-rank array
+    (the Horovod process-local-tensor model mapped onto a global array).
+    Single-process: equivalent to :func:`per_rank`.
+    """
+    mesh, axis = _mesh_axis(process_set)
+    x = np.asarray(x)
+    sharding = _rank_sharding(mesh, axis)
+    if jax.process_count() == 1:
+        return per_rank(list(x), process_set)
+    me = jax.process_index()
+    local_devs = [d for d in mesh.devices.flat if d.process_index == me]
+    if x.shape[0] != len(local_devs):
+        raise ValueError(
+            f"expected {len(local_devs)} local rows, got {x.shape[0]}")
+    n = mesh.shape[axis]
+    shards = [jax.device_put(x[i:i + 1], d)
+              for i, d in enumerate(local_devs)]
+    return jax.make_array_from_single_device_arrays(
+        (n,) + x.shape[1:], sharding, shards)
+
+
+def to_local(x: jax.Array) -> np.ndarray:
+    """Rows of a per-rank/replicated result owned by this process's devices."""
+    if jax.process_count() == 1:
+        return to_numpy(x)
+    shards = [s for s in x.addressable_shards]
+    shards.sort(key=lambda s: s.index)
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+
 # ---------------------------------------------------------------------------
 # Compiled program builders
 # ---------------------------------------------------------------------------

@@ -99,10 +99,18 @@ class Handle:
 class Negotiator:
     """Readiness protocol interface († ``Controller::ComputeResponseList``)."""
 
+    # Distributed protocols are round-barriers: every process must check in
+    # every cycle even with an empty queue († every rank sends its Request
+    # list each cycle, possibly empty).
+    always_check_in = False
+
     def negotiate(self, entries: list[TensorTableEntry]
                   ) -> list[TensorTableEntry]:
         """Return the subset (in agreed order) to execute this cycle."""
         raise NotImplementedError
+
+    def close(self) -> None:
+        pass
 
 
 class SingleControllerNegotiator(Negotiator):
@@ -153,6 +161,7 @@ class CollectiveEngine:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        self._negotiator.close()
         # Fail any stragglers so synchronize() callers don't hang.
         with self._lock:
             for entry, handle in self._queue:
@@ -233,12 +242,16 @@ class CollectiveEngine:
                 log.error("engine stopped by stall shutdown: %s", err)
                 return
 
+    @property
+    def distributed(self) -> bool:
+        return self._negotiator.always_check_in
+
     def _run_cycle(self, batch: list[tuple[TensorTableEntry, Handle]]) -> None:
         self._cycle_count += 1
         tl = self._state.timeline
         if tl is not None:
             tl.mark_cycle()
-        if not batch:
+        if not batch and not self._negotiator.always_check_in:
             return
         t0 = time.monotonic()
         entries = [e for e, _ in batch]

@@ -1,0 +1,48 @@
+"""Distributed negotiation: the engine's multi-process readiness protocol.
+
+† ``controller.cc Controller::ComputeResponseList`` via the native
+coordinator (``horovod_tpu/_native``): every engine cycle, each process
+submits its pending tensor names; the rank-0 coordinator service replies
+with the identical ordered ready-list to every process, which keeps the
+fused XLA dispatches SPMD-consistent across processes (the invariant NCCL
+comm ordering provides in the reference).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .engine import Negotiator, TensorTableEntry
+from ..utils import logging as hvd_logging
+
+log = hvd_logging.get_logger()
+
+
+class DistributedNegotiator(Negotiator):
+    always_check_in = True
+
+    def __init__(self, host: str, port: int, rank: int,
+                 timeout_ms: int = 60000) -> None:
+        from .._native import ControllerClient
+        self._client = ControllerClient(host, port, rank,
+                                        timeout_ms=timeout_ms)
+        self._warned: set[str] = set()
+
+    def negotiate(self, entries: list[TensorTableEntry]
+                  ) -> list[TensorTableEntry]:
+        by_name = {e.name: e for e in entries}
+        ready_names, stalled = self._client.negotiate(list(by_name))
+        for name in stalled:
+            if name not in self._warned:
+                self._warned.add(name)
+                log.warning(
+                    "Negotiation stall: tensor %r submitted by some ranks "
+                    "but not all († stall_inspector)", name)
+        # Order comes from the coordinator; drop names this process hasn't
+        # enqueued yet (they'll be ready here in a later cycle — the
+        # coordinator only marks globally-ready tensors, so this only
+        # happens transiently on requeue races).
+        return [by_name[n] for n in ready_names if n in by_name]
+
+    def close(self) -> None:
+        self._client.close()

@@ -1,0 +1,9 @@
+"""Launcher: the ``horovodrun`` equivalent for TPU-native jobs.
+
+† ``horovod/runner/`` — CLI (``launch.py``), host parsing, rendezvous server,
+per-rank env injection, ssh fan-out, monitor/kill.  Public API parity:
+``horovod_tpu.runner.run(fn_cmd, np=...)`` mirrors ``horovod.run``.
+"""
+
+from .hosts import HostSlots, parse_hosts  # noqa: F401
+from .launch import main, run  # noqa: F401

@@ -1,0 +1,269 @@
+"""hvdrun: spawn, wire, and babysit a multi-process job.
+
+† ``horovod/runner/launch.py`` (CLI), ``gloo_run.py`` (rendezvous + env +
+exec + monitor), ``safe_shell_exec.py`` (process-group kill semantics).
+
+Flow (†3.4):
+1. parse hosts/flags (every config knob has a CLI flag; ``--config-file``
+   YAML mirrors them — the reference's three-surface rule);
+2. start the native rendezvous KV store and the coordinator service in the
+   launcher process;
+3. exec one worker per rank — locally via subprocess, remotely via ssh —
+   with the per-rank env (rank ids + service addresses);
+4. stream output; on any worker failing, terminate the rest (monitor role).
+
+Workers bootstrap in ``horovod_tpu.init()``: JAX distributed init against
+the coordinator address, then the engine connects to the controller.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from .hosts import assign_ranks, parse_hosts
+from .. import config as config_mod
+
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _local_ip() -> str:
+    # Routable address other hosts can reach; localhost jobs don't care.
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu job "
+                    "(reference parity: horovodrun)")
+    p.add_argument("-np", "--num-proc", type=int, required=True,
+                   help="total number of processes")
+    p.add_argument("-H", "--hosts", default=None,
+                   help="host1:slots,host2:slots (default: localhost:np)")
+    p.add_argument("--ssh-port", type=int, default=22)
+    p.add_argument("--start-timeout", type=float, default=120.0,
+                   help="seconds to wait for all workers to register")
+    p.add_argument("--config-file", default=None,
+                   help="YAML file of knobs (mirrors CLI flags)")
+    # Tuning knobs († horovodrun flags mirroring HOROVOD_* envs).
+    p.add_argument("--fusion-threshold-mb", type=float, default=None)
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--autotune", action="store_true", default=False)
+    p.add_argument("--autotune-log", default=None)
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true",
+                   default=False)
+    p.add_argument("--log-level", default=None)
+    p.add_argument("--stall-warning-time", type=float, default=None)
+    p.add_argument("--verbose", "-v", action="store_true")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="program to run (e.g. python train.py)")
+    return p
+
+
+def _knob_env(args) -> dict:
+    env = {}
+    if args.config_file:
+        cfg = config_mod.from_yaml(args.config_file)
+        defaults = config_mod.Config()
+        for field, suffix, _ in config_mod._ENV_TABLE:
+            val = getattr(cfg, field, None)
+            if val is not None and val != getattr(defaults, field):
+                if isinstance(val, bool):
+                    val = "1" if val else "0"
+                env["HVDTPU_" + suffix] = str(val)
+    if args.fusion_threshold_mb is not None:
+        env["HVDTPU_FUSION_THRESHOLD"] = str(
+            int(args.fusion_threshold_mb * 1024 * 1024))
+    if args.cycle_time_ms is not None:
+        env["HVDTPU_CYCLE_TIME"] = str(args.cycle_time_ms)
+    if args.cache_capacity is not None:
+        env["HVDTPU_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.autotune:
+        env["HVDTPU_AUTOTUNE"] = "1"
+    if args.autotune_log:
+        env["HVDTPU_AUTOTUNE_LOG"] = args.autotune_log
+    if args.timeline_filename:
+        env["HVDTPU_TIMELINE"] = args.timeline_filename
+    if args.timeline_mark_cycles:
+        env["HVDTPU_TIMELINE_MARK_CYCLES"] = "1"
+    if args.log_level:
+        env["HVDTPU_LOG_LEVEL"] = args.log_level
+    if args.stall_warning_time is not None:
+        env["HVDTPU_STALL_CHECK_TIME_SECONDS"] = str(args.stall_warning_time)
+    return env
+
+
+class _Worker:
+    def __init__(self, rank: int, proc: subprocess.Popen) -> None:
+        self.rank = rank
+        self.proc = proc
+
+
+def launch_workers(command: Sequence[str], *, np_total: int,
+                   hosts_spec: Optional[str] = None,
+                   extra_env: Optional[dict] = None,
+                   ssh_port: int = 22,
+                   verbose: bool = False,
+                   prefix_output: bool = True) -> int:
+    """Start services + workers; wait; return exit code.  Local ranks run as
+    child processes, remote ranks through ``ssh`` († gloo_run exec path)."""
+    from .._native import ControllerServer, KvServer
+
+    hosts = parse_hosts(hosts_spec) if hosts_spec else \
+        parse_hosts(f"localhost:{np_total}")
+    assignment = assign_ranks(hosts, np_total)
+
+    my_ip = _local_ip()
+    is_local_job = all(h in ("localhost", "127.0.0.1", my_ip)
+                       for _, h, _ in assignment)
+    service_ip = "127.0.0.1" if is_local_job else my_ip
+
+    kv = KvServer()
+    ctrl = ControllerServer(size=np_total)
+    coord_port = _free_port()
+    coord_host = "127.0.0.1" if is_local_job else assignment[0][1]
+
+    workers: List[_Worker] = []
+    failed = threading.Event()
+    exit_codes: dict[int, int] = {}
+
+    def base_env(rank: int, local_rank: int) -> dict:
+        env = dict(os.environ)
+        env.update(extra_env or {})
+        env.update({
+            "HVDTPU_COORDINATOR_ADDR": f"{coord_host}:{coord_port}",
+            "HVDTPU_CROSS_RANK": str(rank),
+            "HVDTPU_CROSS_SIZE": str(np_total),
+            "HVDTPU_CONTROLLER_ADDR": f"{service_ip}:{ctrl.port}",
+            "HVDTPU_RENDEZVOUS_ADDR": f"{service_ip}:{kv.port}",
+            "HVDTPU_LOCAL_RANK": str(local_rank),
+        })
+        return env
+
+    def stream(worker: _Worker) -> None:
+        assert worker.proc.stdout is not None
+        for line in worker.proc.stdout:
+            if prefix_output:
+                sys.stdout.write(f"[{worker.rank}]<stdout>: {line}")
+            else:
+                sys.stdout.write(line)
+            sys.stdout.flush()
+
+    try:
+        for rank, host, local_rank in assignment:
+            env = base_env(rank, local_rank)
+            if host in ("localhost", "127.0.0.1", my_ip):
+                proc = subprocess.Popen(
+                    list(command), env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True, start_new_session=True)
+            else:
+                # ssh fan-out: env goes on the remote command line since ssh
+                # doesn't forward arbitrary vars († gloo_run builds the same
+                # `ssh host env K=V ... cmd` line).
+                env_kv = " ".join(
+                    f"{k}={shlex.quote(v)}" for k, v in env.items()
+                    if k.startswith(("HVDTPU_", "HOROVOD_", "PATH",
+                                     "PYTHONPATH")))
+                remote = f"cd {shlex.quote(os.getcwd())} && env {env_kv} " \
+                    + " ".join(shlex.quote(c) for c in command)
+                proc = subprocess.Popen(
+                    ["ssh", "-p", str(ssh_port),
+                     "-o", "StrictHostKeyChecking=no", host, remote],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True, start_new_session=True)
+            worker = _Worker(rank, proc)
+            workers.append(worker)
+            threading.Thread(target=stream, args=(worker,),
+                             daemon=True).start()
+
+        # Monitor († launcher kills everyone when any worker dies nonzero).
+        pending = {w.rank: w for w in workers}
+        code = 0
+        while pending:
+            for rank_id, w in list(pending.items()):
+                rc = w.proc.poll()
+                if rc is None:
+                    continue
+                exit_codes[rank_id] = rc
+                del pending[rank_id]
+                if rc != 0 and not failed.is_set():
+                    failed.set()
+                    code = rc
+                    if verbose:
+                        print(f"[launcher] rank {rank_id} exited {rc}; "
+                              "terminating remaining workers",
+                              file=sys.stderr)
+                    for other in pending.values():
+                        _terminate(other.proc)
+            time.sleep(0.1)
+        return code
+    finally:
+        for w in workers:
+            if w.proc.poll() is None:
+                _terminate(w.proc)
+        ctrl.stop()
+        kv.stop()
+
+
+def _terminate(proc: subprocess.Popen) -> None:
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        pass
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def run(command: Sequence[str], np: int, *, hosts: Optional[str] = None,
+        env: Optional[dict] = None, verbose: bool = False) -> int:
+    """Python API († ``horovod.run``)."""
+    return launch_workers(command, np_total=np, hosts_spec=hosts,
+                          extra_env=env, verbose=verbose)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    command = list(args.command)
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("hvdrun: no command given", file=sys.stderr)
+        return 2
+    extra_env = _knob_env(args)
+    return launch_workers(command, np_total=args.num_proc,
+                          hosts_spec=args.hosts, extra_env=extra_env,
+                          ssh_port=args.ssh_port, verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,693 @@
+// hvdtpu_core: native runtime for horovod_tpu.
+//
+// TPU-native counterpart of the reference's C++ core (†
+// horovod/common/{message.cc,controller.cc,response_cache.cc,
+// gloo/http_store.cc,stall_inspector.cc}).  What stays native here is the
+// *control plane*: the rendezvous KV store and the rank-0 coordinator that
+// makes every process agree on which named tensors are globally ready and in
+// what order they fuse — the invariant that keeps SPMD collective dispatch
+// identical on all ranks.  The *data plane* (the collectives themselves) is
+// compiled XLA riding ICI/DCN, so no NCCL/MPI-style op backends exist here.
+//
+// Exposed as a C ABI consumed via ctypes (no pybind dependency in the
+// image).  All framing is length-prefixed binary over TCP; see WireFormat
+// below († message.cc Request/Response hand-rolled serialization).
+//
+// Build: make -C native  (produces libhvdtpu_core.so)
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+// ---------------------------------------------------------------------------
+// socket helpers
+// ---------------------------------------------------------------------------
+
+bool send_all(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t len) {
+  char* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+// Frame = u32 length + payload.
+bool send_frame(int fd, const std::string& payload) {
+  uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+  if (!send_all(fd, &len, 4)) return false;
+  return send_all(fd, payload.data(), payload.size());
+}
+
+bool recv_frame(int fd, std::string* out) {
+  uint32_t len_n;
+  if (!recv_all(fd, &len_n, 4)) return false;
+  uint32_t len = ntohl(len_n);
+  if (len > (64u << 20)) return false;  // sanity cap: 64 MB control frames
+  out->resize(len);
+  return len == 0 || recv_all(fd, &(*out)[0], len);
+}
+
+int listen_on(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+int bound_port(int fd) {
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len) != 0) return -1;
+  return ntohs(addr.sin_port);
+}
+
+int connect_to(const char* host, int port, int timeout_ms) {
+  auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+  while (true) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return -1;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    ::close(fd);
+    if (Clock::now() >= deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// WireFormat († message.cc): little helpers for binary pack/unpack
+// ---------------------------------------------------------------------------
+
+void put_u32(std::string* s, uint32_t v) {
+  uint32_t n = htonl(v);
+  s->append(reinterpret_cast<const char*>(&n), 4);
+}
+
+uint32_t get_u32(const std::string& s, size_t* off) {
+  uint32_t n;
+  std::memcpy(&n, s.data() + *off, 4);
+  *off += 4;
+  return ntohl(n);
+}
+
+void put_str(std::string* s, const std::string& v) {
+  put_u32(s, static_cast<uint32_t>(v.size()));
+  s->append(v);
+}
+
+std::string get_str(const std::string& s, size_t* off) {
+  uint32_t len = get_u32(s, off);
+  std::string out = s.substr(*off, len);
+  *off += len;
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// KV store server († gloo/http_store.cc + runner RendezvousServer): the
+// bootstrap rendezvous.  Ops: S<key><val> set, G<key> get (blocking with
+// timeout handled client-side via W), W<key><timeout_ms> wait+get.
+// ---------------------------------------------------------------------------
+
+class KvServer {
+ public:
+  explicit KvServer(int port) {
+    listen_fd_ = listen_on(port);
+    if (listen_fd_ >= 0) {
+      port_ = bound_port(listen_fd_);
+      accept_thread_ = std::thread([this] { AcceptLoop(); });
+    }
+  }
+
+  ~KvServer() { Stop(); }
+
+  int port() const { return port_; }
+  bool ok() const { return listen_fd_ >= 0; }
+
+  void Stop() {
+    bool expected = false;
+    if (!stopping_.compare_exchange_strong(expected, true)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
+      cv_.notify_all();
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : client_threads_) {
+      if (t.joinable()) t.join();
+    }
+  }
+
+ private:
+  void AcceptLoop() {
+    while (!stopping_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      std::lock_guard<std::mutex> g(mu_);
+      client_fds_.insert(fd);
+      client_threads_.emplace_back([this, fd] { ClientLoop(fd); });
+    }
+  }
+
+  void ClientLoop(int fd) {
+    std::string frame;
+    while (!stopping_ && recv_frame(fd, &frame)) {
+      if (frame.empty()) continue;
+      char op = frame[0];
+      size_t off = 1;
+      if (op == 'S') {
+        std::string key = get_str(frame, &off);
+        std::string val = frame.substr(off);
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          table_[key] = val;
+        }
+        cv_.notify_all();
+        send_frame(fd, "K");
+      } else if (op == 'W' || op == 'G') {
+        std::string key = get_str(frame, &off);
+        uint32_t timeout_ms = (op == 'W') ? get_u32(frame, &off) : 0;
+        std::unique_lock<std::mutex> lk(mu_);
+        auto pred = [&] { return table_.count(key) > 0 || stopping_.load(); };
+        if (op == 'W') {
+          cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+        }
+        auto it = table_.find(key);
+        if (it == table_.end()) {
+          lk.unlock();
+          send_frame(fd, "M");  // missing
+        } else {
+          std::string reply = "V" + it->second;
+          lk.unlock();
+          send_frame(fd, reply);
+        }
+      } else if (op == 'D') {  // delete (elastic re-rendezvous reuse)
+        std::string key = get_str(frame, &off);
+        std::lock_guard<std::mutex> g(mu_);
+        table_.erase(key);
+        send_frame(fd, "K");
+      }
+    }
+    ::close(fd);
+  }
+
+  int listen_fd_ = -1;
+  int port_ = -1;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::vector<std::thread> client_threads_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> table_;
+  std::set<int> client_fds_;
+};
+
+class KvClient {
+ public:
+  KvClient(const char* host, int port, int timeout_ms) {
+    fd_ = connect_to(host, port, timeout_ms);
+  }
+  ~KvClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+  bool ok() const { return fd_ >= 0; }
+
+  bool Set(const std::string& key, const std::string& val) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string msg = "S";
+    put_str(&msg, key);
+    msg += val;
+    std::string reply;
+    return send_frame(fd_, msg) && recv_frame(fd_, &reply) && reply == "K";
+  }
+
+  // returns true + val, or false if absent within timeout.
+  bool Wait(const std::string& key, int timeout_ms, std::string* val) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string msg = "W";
+    put_str(&msg, key);
+    put_u32(&msg, static_cast<uint32_t>(timeout_ms));
+    std::string reply;
+    if (!send_frame(fd_, msg) || !recv_frame(fd_, &reply)) return false;
+    if (reply.empty() || reply[0] != 'V') return false;
+    *val = reply.substr(1);
+    return true;
+  }
+
+  bool Del(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string msg = "D";
+    put_str(&msg, key);
+    std::string reply;
+    return send_frame(fd_, msg) && recv_frame(fd_, &reply) && reply == "K";
+  }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+// ---------------------------------------------------------------------------
+// Controller († controller.cc Controller::ComputeResponseList + †
+// response_cache.cc): rank-0 coordinator deciding, per negotiation round,
+// which named tensors are ready on every rank and in what order they fuse.
+//
+// Round protocol (client -> server frame):
+//   u32 rank, u32 n_entries, then per entry either
+//     'N' + str name   (first sighting — server assigns an id)
+//   or
+//     'I' + u32 id     (cache fast path † bit-vector exchange)
+// Server reply:
+//   u32 n_ready, then per ready tensor: u32 id + str name
+//   (names echoed so new ranks can learn ids; † Response joined names),
+//   then u32 n_stalled (informational: tensors some ranks submitted but
+//   others haven't for > stall_warn_ms — † stall_inspector.cc).
+//
+// Ordering invariant: ready tensors are ordered by the round in which they
+// first became globally known, then by rank-0's submission order — giving
+// every rank the identical fuse order without a second broadcast.
+// ---------------------------------------------------------------------------
+
+struct TensorState {
+  uint32_t id;
+  std::string name;
+  std::set<uint32_t> ranks_seen;
+  uint64_t first_seen_round;
+  Clock::time_point first_seen_time;
+};
+
+class Controller {
+ public:
+  Controller(int port, int size, int stall_warn_ms)
+      : size_(static_cast<uint32_t>(size)), stall_warn_ms_(stall_warn_ms) {
+    listen_fd_ = listen_on(port);
+    if (listen_fd_ >= 0) {
+      port_ = bound_port(listen_fd_);
+      accept_thread_ = std::thread([this] { AcceptLoop(); });
+    }
+  }
+
+  ~Controller() { Stop(); }
+
+  bool ok() const { return listen_fd_ >= 0; }
+  int port() const { return port_; }
+
+  void Stop() {
+    bool expected = false;
+    if (!stopping_.compare_exchange_strong(expected, true)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (int fd : all_fds_) ::shutdown(fd, SHUT_RDWR);
+      cv_.notify_all();
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : worker_threads_) {
+      if (t.joinable()) t.join();
+    }
+  }
+
+ private:
+  void AcceptLoop() {
+    while (!stopping_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(mu_);
+      all_fds_.insert(fd);
+      worker_threads_.emplace_back([this, fd] { RankLoop(fd); });
+    }
+  }
+
+  // One thread per rank connection; implements the barrier-per-round
+  // semantics of † MPIController (gather at rank 0, bcast response).
+  void RankLoop(int fd) {
+    uint32_t my_rank = UINT32_MAX;
+    std::string frame;
+    while (!stopping_ && recv_frame(fd, &frame)) {
+      size_t off = 0;
+      uint32_t rank = get_u32(frame, &off);
+      uint32_t n = get_u32(frame, &off);
+      std::vector<std::string> names;
+      std::vector<uint32_t> ids;
+      for (uint32_t i = 0; i < n; ++i) {
+        char tag = frame[off++];
+        if (tag == 'N') {
+          names.push_back(get_str(frame, &off));
+        } else {
+          ids.push_back(get_u32(frame, &off));
+        }
+      }
+
+      std::unique_lock<std::mutex> lk(mu_);
+      if (my_rank == UINT32_MAX) {
+        my_rank = rank;
+        rank_fds_[rank] = fd;
+      }
+      // Record submissions.
+      for (auto& nm : names) RecordName(rank, nm);
+      for (uint32_t id : ids) RecordId(rank, id);
+      arrived_.insert(rank);
+
+      uint64_t round = round_;
+      if (arrived_.size() == size_) {
+        // Last arrival computes the response for everyone († rank-0
+        // coordinator builds the response list once per round).
+        BuildResponse();
+        arrived_.clear();
+        round_++;
+        cv_.notify_all();
+      } else {
+        cv_.wait(lk, [&] { return round_ != round || stopping_.load(); });
+      }
+      if (stopping_) break;
+      std::string reply = last_response_;
+      lk.unlock();
+      send_frame(fd, reply);
+    }
+    ::close(fd);
+  }
+
+  void RecordName(uint32_t rank, const std::string& name) {
+    auto it = by_name_.find(name);
+    if (it == by_name_.end()) {
+      uint32_t id = next_id_++;
+      TensorState st;
+      st.id = id;
+      st.name = name;
+      st.first_seen_round = round_;
+      st.first_seen_time = Clock::now();
+      st.ranks_seen.insert(rank);
+      tensors_[id] = std::move(st);
+      by_name_[name] = id;
+    } else {
+      Touch(tensors_[it->second], rank);
+    }
+  }
+
+  void RecordId(uint32_t rank, uint32_t id) {
+    auto it = tensors_.find(id);
+    if (it != tensors_.end()) Touch(it->second, rank);
+  }
+
+  // A fresh submission cycle starts when a tensor is re-submitted after
+  // completing (steady-state training re-reduces the same names every
+  // step — the reference's TensorQueue removes entries on completion and
+  // re-adds them next step; here the id/name registration persists for the
+  // cache and only the readiness state resets).
+  void Touch(TensorState& st, uint32_t rank) {
+    if (st.ranks_seen.empty()) {
+      st.first_seen_round = round_;
+      st.first_seen_time = Clock::now();
+    }
+    st.ranks_seen.insert(rank);
+  }
+
+  void BuildResponse() {
+    // Ready = seen on every rank; ordered by (first_seen_round, id).
+    std::vector<const TensorState*> ready;
+    std::vector<const TensorState*> stalled;
+    auto now = Clock::now();
+    for (auto& [id, st] : tensors_) {
+      if (st.ranks_seen.empty()) continue;  // idle between cycles
+      if (st.ranks_seen.size() == size_) {
+        ready.push_back(&st);
+      } else if (stall_warn_ms_ > 0 &&
+                 std::chrono::duration_cast<std::chrono::milliseconds>(
+                     now - st.first_seen_time)
+                         .count() > stall_warn_ms_) {
+        stalled.push_back(&st);
+      }
+    }
+    std::sort(ready.begin(), ready.end(),
+              [](const TensorState* a, const TensorState* b) {
+                if (a->first_seen_round != b->first_seen_round)
+                  return a->first_seen_round < b->first_seen_round;
+                return a->id < b->id;
+              });
+    std::string resp;
+    put_u32(&resp, static_cast<uint32_t>(ready.size()));
+    for (auto* st : ready) {
+      put_u32(&resp, st->id);
+      put_str(&resp, st->name);
+      const_cast<TensorState*>(st)->ranks_seen.clear();
+    }
+    put_u32(&resp, static_cast<uint32_t>(stalled.size()));
+    for (auto* st : stalled) put_str(&resp, st->name);
+    last_response_ = resp;
+  }
+
+  uint32_t size_;
+  int stall_warn_ms_;
+  int listen_fd_ = -1;
+  int port_ = -1;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::vector<std::thread> worker_threads_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<uint32_t, int> rank_fds_;
+  std::set<int> all_fds_;
+  std::set<uint32_t> arrived_;
+  uint64_t round_ = 0;
+  uint32_t next_id_ = 0;
+  std::unordered_map<std::string, uint32_t> by_name_;
+  std::map<uint32_t, TensorState> tensors_;
+  std::string last_response_;
+};
+
+// Client side of the negotiation, with the name->id cache († response cache
+// client half: steady state sends ids, not names).
+class CtrlClient {
+ public:
+  CtrlClient(const char* host, int port, int rank, int timeout_ms)
+      : rank_(static_cast<uint32_t>(rank)) {
+    fd_ = connect_to(host, port, timeout_ms);
+  }
+  ~CtrlClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+  bool ok() const { return fd_ >= 0; }
+
+  // names: the tensors newly ready on this rank this round.  Returns the
+  // agreed globally-ready ordered list (empty on protocol failure with
+  // *err set).
+  bool Negotiate(const std::vector<std::string>& names,
+                 std::vector<std::string>* ready,
+                 std::vector<std::string>* stalled) {
+    std::string msg;
+    put_u32(&msg, rank_);
+    put_u32(&msg, static_cast<uint32_t>(names.size()));
+    for (auto& nm : names) {
+      auto it = cache_.find(nm);
+      if (it != cache_.end()) {
+        msg += 'I';
+        put_u32(&msg, it->second);
+      } else {
+        msg += 'N';
+        put_str(&msg, nm);
+      }
+    }
+    std::string reply;
+    if (!send_frame(fd_, msg) || !recv_frame(fd_, &reply)) return false;
+    size_t off = 0;
+    uint32_t n_ready = get_u32(reply, &off);
+    ready->clear();
+    for (uint32_t i = 0; i < n_ready; ++i) {
+      uint32_t id = get_u32(reply, &off);
+      std::string nm = get_str(reply, &off);
+      cache_[nm] = id;
+      ready->push_back(std::move(nm));
+    }
+    uint32_t n_stalled = get_u32(reply, &off);
+    stalled->clear();
+    for (uint32_t i = 0; i < n_stalled; ++i) {
+      stalled->push_back(get_str(reply, &off));
+    }
+    return true;
+  }
+
+  size_t cache_size() const { return cache_.size(); }
+
+ private:
+  int fd_ = -1;
+  uint32_t rank_;
+  std::unordered_map<std::string, uint32_t> cache_;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// -- KV store --
+void* hvd_kv_server_start(int port) {
+  auto* s = new KvServer(port);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+int hvd_kv_server_port(void* s) { return static_cast<KvServer*>(s)->port(); }
+void hvd_kv_server_stop(void* s) { delete static_cast<KvServer*>(s); }
+
+void* hvd_kv_connect(const char* host, int port, int timeout_ms) {
+  auto* c = new KvClient(host, port, timeout_ms);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+int hvd_kv_set(void* c, const char* key, const uint8_t* val, int len) {
+  return static_cast<KvClient*>(c)->Set(
+             key, std::string(reinterpret_cast<const char*>(val),
+                              static_cast<size_t>(len)))
+             ? 0
+             : -1;
+}
+// Returns value length (may exceed cap, caller re-calls with bigger buf), or
+// -1 if absent/timeout.
+int hvd_kv_wait(void* c, const char* key, int timeout_ms, uint8_t* buf,
+                int cap) {
+  std::string val;
+  if (!static_cast<KvClient*>(c)->Wait(key, timeout_ms, &val)) return -1;
+  int n = static_cast<int>(val.size());
+  if (buf != nullptr && cap >= n) std::memcpy(buf, val.data(), val.size());
+  return n;
+}
+int hvd_kv_del(void* c, const char* key) {
+  return static_cast<KvClient*>(c)->Del(key) ? 0 : -1;
+}
+void hvd_kv_close(void* c) { delete static_cast<KvClient*>(c); }
+
+// -- Controller --
+void* hvd_ctrl_server_start(int port, int size, int stall_warn_ms) {
+  auto* s = new Controller(port, size, stall_warn_ms);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+int hvd_ctrl_server_port(void* s) {
+  return static_cast<Controller*>(s)->port();
+}
+void hvd_ctrl_server_stop(void* s) { delete static_cast<Controller*>(s); }
+
+void* hvd_ctrl_connect(const char* host, int port, int rank, int timeout_ms) {
+  auto* c = new CtrlClient(host, port, rank, timeout_ms);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+// names_blob: '\n'-joined tensor names ('' = none).  On success writes
+// '\n'-joined ready list then '\x01' then '\n'-joined stalled list into out
+// and returns total length (or required length if > cap; -1 on failure).
+int hvd_ctrl_negotiate(void* c, const char* names_blob, char* out, int cap) {
+  std::vector<std::string> names;
+  {
+    std::string blob(names_blob);
+    size_t start = 0;
+    while (start < blob.size()) {
+      size_t nl = blob.find('\n', start);
+      if (nl == std::string::npos) nl = blob.size();
+      if (nl > start) names.push_back(blob.substr(start, nl - start));
+      start = nl + 1;
+    }
+  }
+  std::vector<std::string> ready, stalled;
+  if (!static_cast<CtrlClient*>(c)->Negotiate(names, &ready, &stalled))
+    return -1;
+  std::string joined;
+  for (size_t i = 0; i < ready.size(); ++i) {
+    if (i) joined += '\n';
+    joined += ready[i];
+  }
+  joined += '\x01';
+  for (size_t i = 0; i < stalled.size(); ++i) {
+    if (i) joined += '\n';
+    joined += stalled[i];
+  }
+  int n = static_cast<int>(joined.size());
+  if (out != nullptr && cap >= n) std::memcpy(out, joined.data(), joined.size());
+  return n;
+}
+int hvd_ctrl_cache_size(void* c) {
+  return static_cast<int>(static_cast<CtrlClient*>(c)->cache_size());
+}
+void hvd_ctrl_close(void* c) { delete static_cast<CtrlClient*>(c); }
+
+}  // extern "C"

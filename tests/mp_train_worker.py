@@ -1,0 +1,58 @@
+"""Worker script for the multi-process e2e launcher test.
+
+Each of the N processes (1 fake CPU device each) initializes horovod_tpu
+from the launcher-injected env, then exercises the negotiated collective
+path — the whole reference flow of †3.4 (launch) + †3.2 (hot path): async
+enqueue → coordinator negotiation → identical fused dispatch on every
+process → synchronize.
+"""
+
+import os
+import sys
+
+# One CPU device per process = one rank per process (the reference's model).
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=1"
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main() -> int:
+    hvd.init()
+    me = hvd.cross_rank()
+    n = hvd.size()
+    assert hvd.cross_size() == n, (hvd.cross_size(), n)
+
+    # 1. negotiated sync allreduce
+    x = hvd.from_local(np.full((1, 4), float(me + 1), np.float32))
+    out = hvd.to_numpy(hvd.allreduce(x, hvd.Sum))
+    expected = sum(range(1, n + 1))
+    assert np.allclose(out, expected), (out, expected)
+
+    # 2. async + fusion across the negotiated path
+    hs = [hvd.allreduce_async(
+        hvd.from_local(np.full((1, 3), float(me + i), np.float32)),
+        hvd.Average, name=f"grad.{i}") for i in range(5)]
+    for i, h in enumerate(hs):
+        got = hvd.to_numpy(hvd.synchronize(h))
+        want = np.mean([r + i for r in range(n)])
+        assert np.allclose(got, want), (i, got, want)
+
+    # 3. broadcast from rank 1
+    b = hvd.to_numpy(hvd.broadcast(
+        hvd.from_local(np.full((1, 2), float(me), np.float32)), 1))
+    assert np.allclose(b, 1.0), b
+
+    # 4. barrier
+    hvd.barrier()
+
+    print(f"rank {me}: OK sum={float(out[0])}")
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

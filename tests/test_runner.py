@@ -1,0 +1,101 @@
+"""Launcher: host parsing, rank assignment, end-to-end multi-process jobs.
+
+Mirrors † ``test/single/test_run.py`` (arg/host parsing, command
+construction) and † ``test/integration/test_static_run.py`` (really exec the
+launcher end-to-end on localhost).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.runner import parse_hosts
+from horovod_tpu.runner.hosts import assign_ranks
+from horovod_tpu.runner.launch import build_parser, _knob_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# parsing
+# ---------------------------------------------------------------------------
+
+def test_parse_hosts():
+    hs = parse_hosts("a:2,b:4")
+    assert [(h.hostname, h.slots) for h in hs] == [("a", 2), ("b", 4)]
+    assert parse_hosts("solo")[0].slots == 1
+
+
+@pytest.mark.parametrize("bad", ["", ":3", "h:x", "h:0"])
+def test_parse_hosts_bad(bad):
+    with pytest.raises(ValueError):
+        parse_hosts(bad)
+
+
+def test_assign_ranks():
+    hs = parse_hosts("a:2,b:2")
+    assert assign_ranks(hs, 3) == [(0, "a", 0), (1, "a", 1), (2, "b", 0)]
+    with pytest.raises(ValueError):
+        assign_ranks(hs, 5)
+
+
+def test_cli_knob_env():
+    args = build_parser().parse_args(
+        ["-np", "2", "--fusion-threshold-mb", "8", "--cycle-time-ms", "2.5",
+         "--autotune", "--log-level", "debug", "--", "python", "x.py"])
+    env = _knob_env(args)
+    assert env["HVDTPU_FUSION_THRESHOLD"] == str(8 * 1024 * 1024)
+    assert env["HVDTPU_CYCLE_TIME"] == "2.5"
+    assert env["HVDTPU_AUTOTUNE"] == "1"
+    assert env["HVDTPU_LOG_LEVEL"] == "debug"
+
+
+def test_cli_config_file(tmp_path):
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text("cycle_time_ms: 7.5\nautotune: true\n")
+    args = build_parser().parse_args(
+        ["-np", "1", "--config-file", str(cfg), "--", "true"])
+    env = _knob_env(args)
+    assert env["HVDTPU_CYCLE_TIME"] == "7.5"
+    assert env["HVDTPU_AUTOTUNE"] == "1"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end († test_static_run)
+# ---------------------------------------------------------------------------
+
+def _hvdrun(np_, script_args, timeout=240):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # workers force CPU
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_), "--",
+         sys.executable] + script_args,
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+@pytest.mark.integration
+def test_hvdrun_two_process_collectives():
+    res = _hvdrun(2, [os.path.join(REPO, "tests", "mp_train_worker.py")])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "rank 0: OK" in res.stdout
+    assert "rank 1: OK" in res.stdout
+
+
+@pytest.mark.integration
+def test_hvdrun_worker_failure_kills_job():
+    code = ("import sys, os; "
+            "sys.exit(3 if os.environ['HVDTPU_CROSS_RANK'] == '1' else 0)")
+    res = _hvdrun(2, ["-c", code])
+    assert res.returncode == 3
+
+
+@pytest.mark.integration
+def test_hvdrun_no_command():
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "1"],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert res.returncode == 2
+    assert "no command" in res.stderr
