@@ -1,0 +1,29 @@
+"""Elastic training: fault-tolerant loops with dynamic membership.
+
+† ``horovod/common/elastic.py`` (``run`` decorator, ``State``,
+``ObjectState``), ``horovod/torch/elastic/state.py`` (``TorchState``),
+``horovod/runner/elastic/`` (driver side — see
+:mod:`horovod_tpu.runner.elastic`).
+
+Reference protocol (†3.5): the user wraps the train loop in
+``@hvd.elastic.run`` with a ``State``; on ``HorovodInternalError`` (a
+collective failed → a peer died) the loop restores the last committed
+snapshot, re-initializes collectives, and retries; on
+``HostsUpdatedInterrupt`` (driver pushed a membership change) it syncs
+state from rank 0 and continues; ``state.commit()`` snapshots at batch
+boundaries.
+
+TPU adaptation: membership is slice-granular (a failed chip takes its slice
+replica out), and "re-initialize collectives" = tear down and re-init the
+runtime on the new device set, then re-place state onto the new mesh.
+Snapshots are host-side (device_get) so they survive mesh teardown —
+same as the reference's host-RAM ``TorchState`` copies.
+"""
+
+from .state import State, ObjectState, JaxState  # noqa: F401
+from .runner import (  # noqa: F401
+    HostsUpdatedInterrupt,
+    WorkerNotificationClient,
+    run,
+)
+from ..ops.engine import HorovodInternalError  # noqa: F401

@@ -1,0 +1,106 @@
+"""Elastic worker-side loop: the ``@hvd.elastic.run`` decorator and the
+driver-notification client.
+
+† ``horovod/common/elastic.py run_fn`` (the catch/restore/reinit loop) and
+† ``horovod/runner/elastic/worker.py WorkerNotificationService`` — here the
+notification channel is the native KV store (the driver bumps an epoch key;
+workers poll it at commit boundaries), replacing the reference's
+socket-RPC notification service with the same at-commit-boundary semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Callable, Optional
+
+from ..ops.engine import HorovodInternalError
+from ..utils import logging as hvd_logging
+
+log = hvd_logging.get_logger()
+
+_EPOCH_KEY = "elastic/membership_epoch"
+
+
+class HostsUpdatedInterrupt(Exception):
+    """† ``HostsUpdatedInterrupt``: driver reported a membership change;
+    sync state and continue (no rollback needed — nothing failed)."""
+
+
+class WorkerNotificationClient:
+    """Polls the driver's membership epoch in the KV store."""
+
+    def __init__(self, addr: Optional[str] = None) -> None:
+        addr = addr or os.environ.get("HVDTPU_RENDEZVOUS_ADDR")
+        self._client = None
+        self._last_epoch = 0
+        if addr:
+            from .._native import KvClient
+            host, _, port = addr.rpartition(":")
+            try:
+                self._client = KvClient(host or "127.0.0.1", int(port),
+                                        timeout_ms=2000)
+                self._last_epoch = self._read_epoch()
+            except (ConnectionError, ValueError):
+                log.warning("elastic: cannot reach rendezvous at %s", addr)
+
+    def _read_epoch(self) -> int:
+        assert self._client is not None
+        raw = self._client.get(_EPOCH_KEY)
+        return int(raw) if raw else 0
+
+    def check(self) -> None:
+        """Raise HostsUpdatedInterrupt if membership changed since last
+        check; called from ``State.commit()``."""
+        if self._client is None:
+            return
+        epoch = self._read_epoch()
+        if epoch != self._last_epoch:
+            self._last_epoch = epoch
+            raise HostsUpdatedInterrupt(f"membership epoch -> {epoch}")
+
+    @staticmethod
+    def bump(kv_client) -> None:
+        """Driver side: signal a membership change."""
+        raw = kv_client.get(_EPOCH_KEY)
+        epoch = int(raw) if raw else 0
+        kv_client.set(_EPOCH_KEY, str(epoch + 1).encode())
+
+
+def _reinitialize() -> None:
+    """Tear down and re-init the runtime on the (possibly changed) device
+    set — the TPU analogue of re-forming the Gloo ring (†3.5 reinit)."""
+    import horovod_tpu as hvd
+    hvd.shutdown()
+    hvd.init()
+
+
+def run(func: Callable[..., Any]) -> Callable[..., Any]:
+    """† ``hvd.elastic.run`` decorator.
+
+    ``func(state, *args, **kwargs)`` is retried under the elastic protocol:
+    ``HorovodInternalError`` → restore + reinit + on_reset;
+    ``HostsUpdatedInterrupt`` → sync and continue.
+    """
+
+    @functools.wraps(func)
+    def wrapper(state, *args: Any, **kwargs: Any) -> Any:
+        notifier = WorkerNotificationClient()
+        state._notifier = notifier
+        first = True
+        while True:
+            if not first:
+                state.on_reset()
+            first = False
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError as e:
+                log.warning("elastic: collective failure (%s); rolling back "
+                            "to last commit and re-initializing", e)
+                _reinitialize()
+                state.restore()
+            except HostsUpdatedInterrupt as e:
+                log.info("elastic: %s; syncing state from rank 0", e)
+                state.sync()
+
+    return wrapper

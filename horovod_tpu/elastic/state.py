@@ -1,0 +1,122 @@
+"""Elastic state objects: commit / restore / sync.
+
+† ``horovod/common/elastic.py`` ``State``/``ObjectState`` and
+† ``horovod/torch/elastic/state.py`` ``TorchState``.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+
+class State:
+    """Snapshot protocol: ``commit()`` at safe points, ``restore()`` on
+    failure rollback, ``sync()`` after membership changes (re-broadcast from
+    rank 0 so joining workers get current values)."""
+
+    def __init__(self) -> None:
+        self._reset_callbacks: list[Callable[[], None]] = []
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        """† ``State.register_reset_callbacks`` — called after re-init
+        (e.g. rebuild optimizer for a new world size)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        for cb in self._reset_callbacks:
+            cb()
+
+    def commit(self) -> None:
+        self.save()
+        self.check_host_updates()
+
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def check_host_updates(self) -> None:
+        """Raise ``HostsUpdatedInterrupt`` when the driver signalled a
+        membership change; wired up by the ``run`` decorator."""
+        notifier = getattr(self, "_notifier", None)
+        if notifier is not None:
+            notifier.check()
+
+
+class ObjectState(State):
+    """Arbitrary picklable attributes († ``ObjectState``): everything set
+    via ``__init__(**kwargs)`` or attribute assignment is snapshot."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._saved: dict[str, Any] = {}
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self.save()
+
+    def _public(self) -> dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")}
+
+    def save(self) -> None:
+        self._saved = copy.deepcopy(self._public())
+
+    def restore(self) -> None:
+        for k, v in copy.deepcopy(self._saved).items():
+            setattr(self, k, v)
+
+    def sync(self) -> None:
+        import horovod_tpu as hvd
+        synced = hvd.broadcast_object(self._public(), root_rank=0)
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.save()
+
+
+class JaxState(State):
+    """Pytree state (params / opt_state / step counter) with host-side
+    snapshots that survive mesh teardown († ``TorchState`` keeps host copies
+    of tensors; here ``device_get`` at commit, ``device_put`` replicated at
+    restore/sync)."""
+
+    def __init__(self, **trees: Any) -> None:
+        super().__init__()
+        self._trees: dict[str, Any] = dict(trees)
+        self._saved: dict[str, Any] = {}
+        self.save()
+
+    def __getattr__(self, name: str) -> Any:
+        trees = self.__dict__.get("_trees", {})
+        if name in trees:
+            return trees[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name.startswith("_"):
+            super().__setattr__(name, value)
+        else:
+            self._trees[name] = value
+
+    def save(self) -> None:
+        self._saved = {k: jax.tree.map(lambda a: np.asarray(jax.device_get(a)),
+                                       v)
+                       for k, v in self._trees.items()}
+
+    def restore(self) -> None:
+        import horovod_tpu as hvd
+        for k, host_tree in self._saved.items():
+            self._trees[k] = hvd.broadcast_parameters(host_tree, root_rank=0)
+
+    def sync(self) -> None:
+        import horovod_tpu as hvd
+        for k, tree in self._trees.items():
+            self._trees[k] = hvd.broadcast_parameters(tree, root_rank=0)
+        self.save()

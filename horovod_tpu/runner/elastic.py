@@ -1,0 +1,180 @@
+"""Elastic driver: host discovery, blacklist, assignment, job supervision.
+
+† ``horovod/runner/elastic/driver.py`` (``ElasticDriver``,
+``HostAssignment``), ``discovery.py`` (``HostDiscovery`` script polling),
+``registration.py`` (blacklist), ``worker.py`` (notification).
+
+TPU adaptation (SURVEY §5.3): chip/slice failures are coarser than GPU-host
+failures and XLA meshes are static, so membership changes restart the *job*
+(workers reload from their committed state/checkpoints) rather than patching
+a live ring.  The driver supervises that loop: poll discovery → compute
+assignment (respecting the blacklist) → launch → on worker death, blacklist
+the host and relaunch → on discovery change, bump the membership epoch (the
+workers' ``WorkerNotificationClient`` raises ``HostsUpdatedInterrupt`` at
+their next commit, letting them exit cleanly for the relaunch).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+from .hosts import HostSlots, assign_ranks, parse_hosts
+from ..utils import logging as hvd_logging
+
+log = hvd_logging.get_logger()
+
+
+class HostDiscovery:
+    """† ``HostDiscovery`` interface."""
+
+    def find_available_hosts(self) -> List[HostSlots]:
+        raise NotImplementedError
+
+
+class ScriptDiscovery(HostDiscovery):
+    """† ``HostDiscoveryScript``: an executable printing ``host:slots``
+    lines (the ``--host-discovery-script`` contract)."""
+
+    def __init__(self, script: str, timeout: float = 30.0) -> None:
+        self._script = script
+        self._timeout = timeout
+
+    def find_available_hosts(self) -> List[HostSlots]:
+        res = subprocess.run([self._script], capture_output=True, text=True,
+                             timeout=self._timeout)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"discovery script failed ({res.returncode}): {res.stderr}")
+        spec = ",".join(line.strip() for line in res.stdout.splitlines()
+                        if line.strip())
+        return parse_hosts(spec) if spec else []
+
+
+class FixedDiscovery(HostDiscovery):
+    """Deterministic sequence of host lists (the reference's fake-discovery
+    unit-test rig † ``test_elastic_driver.py``); repeats the last entry."""
+
+    def __init__(self, *host_specs: str) -> None:
+        self._specs = [parse_hosts(s) if s else [] for s in host_specs]
+        self._i = 0
+
+    def find_available_hosts(self) -> List[HostSlots]:
+        spec = self._specs[min(self._i, len(self._specs) - 1)]
+        self._i += 1
+        return spec
+
+
+class ElasticDriver:
+    """Membership brain: current hosts − blacklist → rank assignment."""
+
+    def __init__(self, discovery: HostDiscovery, *, min_np: int,
+                 max_np: Optional[int] = None,
+                 poll_interval_s: float = 1.0) -> None:
+        if min_np < 1:
+            raise ValueError("min_np must be >= 1")
+        self._discovery = discovery
+        self.min_np = min_np
+        self.max_np = max_np
+        self._poll_interval = poll_interval_s
+        self._blacklist: set[str] = set()
+        self._lock = threading.Lock()
+        self._current_hosts: List[HostSlots] = []
+        self.membership_epoch = 0
+
+    # -- membership ---------------------------------------------------------
+    def blacklist(self, hostname: str) -> None:
+        """† ``registration.py``: a host whose worker crashed is excluded
+        from future assignments."""
+        with self._lock:
+            self._blacklist.add(hostname)
+        log.warning("elastic: blacklisted host %s", hostname)
+
+    def blacklisted(self) -> set[str]:
+        with self._lock:
+            return set(self._blacklist)
+
+    def poll_hosts(self) -> bool:
+        """Refresh from discovery; returns True if membership changed."""
+        hosts = [h for h in self._discovery.find_available_hosts()
+                 if h.hostname not in self.blacklisted()]
+        with self._lock:
+            changed = hosts != self._current_hosts
+            if changed:
+                self._current_hosts = hosts
+                self.membership_epoch += 1
+        return changed
+
+    def wait_for_available_slots(self, min_np: Optional[int] = None,
+                                 timeout_s: float = 600.0
+                                 ) -> List[HostSlots]:
+        """† ``ElasticDriver.wait_for_available_slots``: block until at
+        least min_np slots exist among non-blacklisted hosts."""
+        need = min_np if min_np is not None else self.min_np
+        deadline = time.monotonic() + timeout_s
+        while True:
+            self.poll_hosts()
+            with self._lock:
+                hosts = list(self._current_hosts)
+            if sum(h.slots for h in hosts) >= need:
+                return hosts
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"not enough hosts for min_np={need} within "
+                    f"{timeout_s}s (have {hosts}, "
+                    f"blacklist {sorted(self.blacklisted())})")
+            time.sleep(self._poll_interval)
+
+    def assignment(self, hosts: Optional[Sequence[HostSlots]] = None
+                   ) -> List[tuple[int, str, int]]:
+        """Rank assignment over current (or given) hosts, capped at max_np."""
+        if hosts is None:
+            with self._lock:
+                hosts = list(self._current_hosts)
+        total = sum(h.slots for h in hosts)
+        np_total = min(total, self.max_np) if self.max_np else total
+        return assign_ranks(list(hosts), np_total)
+
+    # -- supervision --------------------------------------------------------
+    def run_job(self, command: Sequence[str], *,
+                max_restarts: int = 10,
+                extra_env: Optional[dict] = None,
+                launcher: Optional[Callable] = None,
+                on_epoch_change: Optional[Callable] = None) -> int:
+        """Supervise the elastic job: (re)launch on the current assignment
+        until it exits 0 or restarts are exhausted.
+
+        ``launcher`` defaults to :func:`horovod_tpu.runner.launch.launch_workers`
+        (injectable for tests).
+        """
+        if launcher is None:
+            from .launch import launch_workers
+
+            def launcher(cmd, hosts, env):
+                spec = ",".join(f"{h.hostname}:{h.slots}" for h in hosts)
+                np_total = min(sum(h.slots for h in hosts),
+                               self.max_np or 10 ** 9)
+                return launch_workers(cmd, np_total=np_total,
+                                      hosts_spec=spec, extra_env=env)
+
+        restarts = 0
+        while True:
+            hosts = self.wait_for_available_slots()
+            epoch = self.membership_epoch
+            log.info("elastic: launching on %s (epoch %d)", hosts, epoch)
+            env = dict(extra_env or {})
+            env["HVDTPU_ELASTIC"] = "1"
+            code = launcher(list(command), hosts, env)
+            if code == 0:
+                return 0
+            restarts += 1
+            if restarts > max_restarts:
+                log.error("elastic: giving up after %d restarts", restarts)
+                return code
+            # A nonzero exit means some worker died; refresh membership and
+            # let discovery/blacklist shape the next assignment.
+            self.poll_hosts()
+            if on_epoch_change and self.membership_epoch != epoch:
+                on_epoch_change(self.membership_epoch)

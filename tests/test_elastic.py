@@ -1,0 +1,182 @@
+"""Elastic: state commit/restore/sync, driver assignment/blacklist, and the
+retry loop.
+
+Mirrors † ``test/single/test_elastic_driver.py`` (fake discovery, assert
+rank assignments and blacklisting without real hosts) and
+† ``test_torch_elastic.py`` (state commit/restore in-process).
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.elastic import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+    JaxState,
+    ObjectState,
+    run,
+)
+from horovod_tpu.runner.elastic import ElasticDriver, FixedDiscovery
+from horovod_tpu.runner.hosts import HostSlots
+
+
+# ---------------------------------------------------------------------------
+# State objects
+# ---------------------------------------------------------------------------
+
+def test_object_state_commit_restore():
+    s = ObjectState(epoch=0, best=1.5)
+    s.epoch = 7
+    s.best = 0.2
+    s.restore()                       # nothing committed since init
+    assert s.epoch == 0 and s.best == 1.5
+    s.epoch = 3
+    s.commit()
+    s.epoch = 9
+    s.restore()
+    assert s.epoch == 3
+
+
+def test_jax_state_commit_restore():
+    params = {"w": np.arange(4.0, dtype=np.float32)}
+    s = JaxState(params=params, step=np.int32(0))
+    s.params = {"w": np.asarray(s.params["w"]) * 2}
+    s.commit()
+    s.params = {"w": np.zeros(4, np.float32)}
+    s.restore()
+    np.testing.assert_allclose(np.asarray(s.params["w"]),
+                               np.arange(4.0) * 2)
+    # restored values are live replicated device arrays
+    assert s.params["w"].sharding.is_fully_replicated
+
+
+def test_jax_state_sync_broadcasts():
+    s = JaxState(params={"w": np.full((2,), 5.0, np.float32)})
+    s.sync()
+    np.testing.assert_allclose(np.asarray(s.params["w"]), 5.0)
+
+
+# ---------------------------------------------------------------------------
+# run decorator protocol
+# ---------------------------------------------------------------------------
+
+def test_run_retries_on_internal_error(monkeypatch):
+    calls = {"n": 0, "restored": 0, "reset": 0}
+
+    class S(ObjectState):
+        def restore(self):
+            calls["restored"] += 1
+            super().restore()
+
+    state = S(step=0)
+    state.register_reset_callbacks([lambda: calls.__setitem__(
+        "reset", calls["reset"] + 1)])
+
+    monkeypatch.setattr("horovod_tpu.elastic.runner._reinitialize",
+                        lambda: None)
+
+    @run
+    def train(st):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise HorovodInternalError("peer died")
+        return "done"
+
+    assert train(state) == "done"
+    assert calls["n"] == 3
+    assert calls["restored"] == 2
+    assert calls["reset"] == 2
+
+
+def test_run_syncs_on_hosts_updated():
+    calls = {"n": 0, "synced": 0}
+
+    class S(ObjectState):
+        def sync(self):
+            calls["synced"] += 1
+            super().sync()
+
+    state = S(step=0)
+
+    @run
+    def train(st):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise HostsUpdatedInterrupt("new host")
+        return st.step
+
+    assert train(state) == 0
+    assert calls["synced"] == 1
+
+
+# ---------------------------------------------------------------------------
+# driver († test_elastic_driver.py)
+# ---------------------------------------------------------------------------
+
+def test_driver_assignment_and_epoch():
+    d = ElasticDriver(FixedDiscovery("a:2,b:2"), min_np=2)
+    hosts = d.wait_for_available_slots()
+    assert [h.hostname for h in hosts] == ["a", "b"]
+    assert d.assignment(hosts) == [(0, "a", 0), (1, "a", 1),
+                                   (2, "b", 0), (3, "b", 1)]
+    assert d.membership_epoch == 1
+
+
+def test_driver_blacklist_excludes_host():
+    d = ElasticDriver(FixedDiscovery("a:2,b:2"), min_np=1)
+    d.wait_for_available_slots()
+    d.blacklist("a")
+    d.poll_hosts()
+    assert [host for _, host, _ in d.assignment()] == ["b", "b"]
+
+
+def test_driver_membership_change_bumps_epoch():
+    d = ElasticDriver(FixedDiscovery("a:2", "a:2,b:2"), min_np=1,
+                      poll_interval_s=0.01)
+    d.poll_hosts()
+    e1 = d.membership_epoch
+    assert d.poll_hosts()            # b joined
+    assert d.membership_epoch == e1 + 1
+
+
+def test_driver_max_np_caps_assignment():
+    d = ElasticDriver(FixedDiscovery("a:4,b:4"), min_np=1, max_np=3)
+    d.poll_hosts()
+    assert len(d.assignment()) == 3
+
+
+def test_driver_min_np_timeout():
+    d = ElasticDriver(FixedDiscovery("a:1"), min_np=4, poll_interval_s=0.01)
+    with pytest.raises(TimeoutError):
+        d.wait_for_available_slots(timeout_s=0.1)
+
+
+def test_driver_run_job_relaunches_and_blacklists():
+    # Fake launcher: first attempt "fails" (worker on host b died), second
+    # succeeds after b is blacklisted.
+    d = ElasticDriver(FixedDiscovery("a:2,b:2"), min_np=1,
+                      poll_interval_s=0.01)
+    attempts = []
+
+    def fake_launcher(cmd, hosts, env):
+        attempts.append([h.hostname for h in hosts])
+        assert env["HVDTPU_ELASTIC"] == "1"
+        if len(attempts) == 1:
+            d.blacklist("b")     # monitor observed b's worker die
+            return 1
+        return 0
+
+    code = d.run_job(["python", "train.py"], launcher=fake_launcher)
+    assert code == 0
+    assert attempts[0] == ["a", "b"]
+    assert attempts[1] == ["a"]
+
+
+def test_script_discovery(tmp_path):
+    from horovod_tpu.runner.elastic import ScriptDiscovery
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\necho host1:2\necho host2:4\n")
+    script.chmod(0o755)
+    hosts = ScriptDiscovery(str(script)).find_available_hosts()
+    assert hosts == [HostSlots("host1", 2), HostSlots("host2", 4)]
