@@ -1,0 +1,148 @@
+"""Keras binding: the reference's Keras callback surface on the TPU-native
+runtime.
+
+† ``horovod/keras/__init__.py`` + ``horovod/_keras/callbacks.py``:
+``BroadcastGlobalVariablesCallback`` (step-0 weight sync),
+``MetricAverageCallback`` (cross-rank metric averaging at epoch end),
+``LearningRateWarmupCallback`` / ``LearningRateScheduleCallback``.
+
+Works with Keras 3 on any backend (weights move via numpy, collectives via
+the horovod_tpu runtime).  For the training *data plane* on TPU, prefer the
+JAX path (Keras 3 jax backend or flax models) — these callbacks cover the
+coordination surface that made ``hvd.keras`` useful: consistent init,
+averaged metrics, epoch-scaled learning rates.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import horovod_tpu as _hvd
+from horovod_tpu import (  # noqa: F401  (reference: hvd.* passthrough)
+    init,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    is_initialized,
+)
+
+try:  # Keras 3 ships with TF 2.21; tolerate its absence for doc builds.
+    import keras
+    _Callback = keras.callbacks.Callback
+except Exception:  # pragma: no cover
+    keras = None
+
+    class _Callback:  # type: ignore[no-redef]
+        pass
+
+
+class BroadcastGlobalVariablesCallback(_Callback):
+    """† ``BroadcastGlobalVariablesCallback``: broadcast initial model
+    weights from ``root_rank`` before training so all ranks start
+    identically (the step-0 sync of †3.3)."""
+
+    def __init__(self, root_rank: int = 0) -> None:
+        super().__init__()
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_train_begin(self, logs=None) -> None:
+        if self._done:
+            return
+        weights = self.model.get_weights()
+        synced = _hvd.broadcast_parameters(
+            {str(i): w for i, w in enumerate(weights)},
+            root_rank=self.root_rank)
+        self.model.set_weights(
+            [np.asarray(_hvd.to_numpy(synced[str(i)]))
+             for i in range(len(weights))])
+        self._done = True
+
+
+class MetricAverageCallback(_Callback):
+    """† ``MetricAverageCallback``: average epoch-end metrics across ranks
+    so rank-0's logs/checkpoint decisions reflect the whole job."""
+
+    def on_epoch_end(self, epoch, logs=None) -> None:
+        if not logs:
+            return
+        keys = sorted(k for k, v in logs.items()
+                      if isinstance(v, (int, float, np.floating)))
+        if not keys:
+            return
+        values = np.asarray([float(logs[k]) for k in keys], np.float32)
+        reps = _hvd.local_size()
+        averaged = _hvd.to_numpy(_hvd.allreduce(
+            _hvd.from_local(np.repeat(values[None], reps, axis=0)),
+            _hvd.Average))
+        for k, v in zip(keys, averaged):
+            logs[k] = float(v)
+
+
+class LearningRateWarmupCallback(_Callback):
+    """† ``LearningRateWarmupCallback``: ramp lr from ``initial_lr`` to
+    ``initial_lr * multiplier`` over ``warmup_epochs`` (Goyal et al. linear
+    scaling warmup), batch-granular."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: float = 5.0,
+                 multiplier: Optional[float] = None,
+                 steps_per_epoch: Optional[int] = None,
+                 verbose: bool = False) -> None:
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.warmup_epochs = warmup_epochs
+        self.multiplier = multiplier if multiplier is not None else \
+            float(_hvd.size())
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self._step = 0
+
+    def _set_lr(self, lr: float) -> None:
+        self.model.optimizer.learning_rate = lr
+
+    def on_train_begin(self, logs=None) -> None:
+        if self.steps_per_epoch is None:
+            params = getattr(self, "params", None) or {}
+            self.steps_per_epoch = params.get("steps") or 100
+
+    def on_train_batch_begin(self, batch, logs=None) -> None:
+        total = self.warmup_epochs * self.steps_per_epoch
+        if self._step >= total:
+            return
+        progress = self._step / max(total, 1)
+        lr = self.initial_lr * (1.0 + progress * (self.multiplier - 1.0))
+        self._set_lr(lr)
+        self._step += 1
+        if self._step == total:
+            self._set_lr(self.initial_lr * self.multiplier)
+            if self.verbose:
+                print(f"warmup complete: lr={self.initial_lr * self.multiplier}")
+
+
+class LearningRateScheduleCallback(_Callback):
+    """† ``LearningRateScheduleCallback``: multiply the base lr by
+    ``multiplier(epoch)`` within [start_epoch, end_epoch)."""
+
+    def __init__(self, initial_lr: float,
+                 multiplier: Callable[[int], float] | float,
+                 start_epoch: int = 0,
+                 end_epoch: Optional[int] = None) -> None:
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+
+    def on_epoch_begin(self, epoch, logs=None) -> None:
+        if epoch < self.start_epoch:
+            return
+        if self.end_epoch is not None and epoch >= self.end_epoch:
+            return
+        self.model.optimizer.learning_rate = \
+            self.initial_lr * self.multiplier(epoch)

@@ -1,0 +1,112 @@
+"""BERT — BASELINE config 3 (reference: TF Keras BERT-Large pretraining
+scripts run under ``horovodrun`` with the hvd callbacks).
+
+TPU-first: bf16 encoder with fp32 layernorm/softmax, MXU-friendly sizes,
+MLM pretraining objective; data-parallel by default, tensor-parallel via
+the same logical-sharding rules as the flagship when run on a tp mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 1024          # BERT-Large
+    n_layers: int = 24
+    n_heads: int = 16
+    d_ff: int = 4096
+    max_seq: int = 512
+    type_vocab: int = 2
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def tiny(**kw) -> "BertConfig":
+        base = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    d_ff=128, max_seq=64, dtype=jnp.float32)
+        base.update(kw)
+        return BertConfig(**base)
+
+    @staticmethod
+    def bert_large(**kw) -> "BertConfig":
+        return BertConfig(**kw)
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, h, mask):
+        cfg = self.cfg
+        x = nn.LayerNorm(dtype=jnp.float32)(h)
+        attn = nn.MultiHeadDotProductAttention(
+            num_heads=cfg.n_heads, dtype=cfg.dtype,
+            qkv_features=cfg.d_model)(x, x, mask=mask)
+        h = h + attn
+        x = nn.LayerNorm(dtype=jnp.float32)(h)
+        y = nn.Dense(cfg.d_ff, dtype=cfg.dtype)(x)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.d_model, dtype=cfg.dtype)(y)
+        return h + y
+
+
+class Bert(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, token_types=None, attn_mask=None):
+        cfg = self.cfg
+        B, S = tokens.shape
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model,
+                         dtype=cfg.dtype, name="tok_embed")
+        h = embed(tokens)
+        pos = nn.Embed(cfg.max_seq, cfg.d_model, dtype=cfg.dtype,
+                       name="pos_embed")(jnp.arange(S)[None, :])
+        h = h + pos
+        if token_types is not None:
+            h = h + nn.Embed(cfg.type_vocab, cfg.d_model, dtype=cfg.dtype,
+                             name="type_embed")(token_types)
+        h = nn.LayerNorm(dtype=jnp.float32)(h)
+        if attn_mask is None:
+            attn_mask = jnp.ones((B, S), jnp.int32)
+        mask = attn_mask[:, None, None, :].astype(bool)
+        for _ in range(cfg.n_layers):
+            h = EncoderLayer(cfg)(h, mask)
+        h = nn.LayerNorm(dtype=jnp.float32)(h)
+        # MLM head: tied to token embedding († standard BERT pretraining).
+        logits = embed.attend(h.astype(cfg.dtype))
+        return logits.astype(jnp.float32)
+
+
+def mlm_loss(params, batch, model: Bert) -> jax.Array:
+    """Masked-LM objective: batch = tokens [B,S], labels [B,S] (-100 =
+    unmasked position, excluded from the loss)."""
+    logits = model.apply(params, batch["tokens"],
+                         attn_mask=batch.get("attn_mask"))
+    labels = batch["labels"]
+    valid = labels >= 0
+    safe_labels = jnp.where(valid, labels, 0)
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits, safe_labels)
+    return (losses * valid).sum() / jnp.maximum(valid.sum(), 1)
+
+
+def synthetic_mlm_batch(cfg: BertConfig, batch: int, seq: int, seed: int = 0,
+                        mask_rate: float = 0.15) -> dict:
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(0, cfg.vocab_size, size=(batch, seq))
+    labels = np.full((batch, seq), -100, np.int32)
+    mask = rng.rand(batch, seq) < mask_rate
+    labels[mask] = tokens[mask]
+    tokens[mask] = 0  # [MASK] id
+    return {"tokens": jnp.asarray(tokens, jnp.int32),
+            "labels": jnp.asarray(labels, jnp.int32)}

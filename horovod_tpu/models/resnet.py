@@ -1,0 +1,85 @@
+"""ResNet-50 — BASELINE config 2 (reference example:
+† ``examples/keras/keras_imagenet_resnet50.py`` /
+``examples/pytorch/pytorch_imagenet_resnet50.py``).
+
+TPU-first: NHWC layout (native for TPU convolutions), bfloat16 compute with
+fp32 batch-norm statistics, and an optional cross-replica SyncBatchNorm
+(† ``horovod/torch/sync_batch_norm.py``) for small per-chip batches.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: int = 1
+    projection: bool = False
+    norm: Callable = nn.BatchNorm
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False,
+                    dtype=self.dtype)(x)
+        y = self.norm(use_running_average=not train)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), strides=(self.strides,) * 2,
+                    use_bias=False, dtype=self.dtype)(y)
+        y = self.norm(use_running_average=not train)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features * 4, (1, 1), use_bias=False,
+                    dtype=self.dtype)(y)
+        y = self.norm(use_running_average=not train, scale_init=nn.initializers.zeros)(y)
+        if self.projection or self.strides != 1:
+            residual = nn.Conv(self.features * 4, (1, 1),
+                               strides=(self.strides,) * 2, use_bias=False,
+                               dtype=self.dtype)(residual)
+            residual = self.norm(use_running_average=not train)(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet-v1.5 family; stage_sizes (3,4,6,3) = ResNet-50."""
+
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    axis_name: Optional[str] = None  # set for SyncBatchNorm over an axis
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, momentum=0.9, epsilon=1e-5,
+                       dtype=jnp.float32, axis_name=self.axis_name)
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=self.dtype, name="conv_init")(x)
+        x = norm(use_running_average=not train, name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                x = BottleneckBlock(
+                    self.width * 2 ** i,
+                    strides=2 if j == 0 and i > 0 else 1,
+                    projection=(j == 0),
+                    norm=norm, dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def resnet50(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, **kw)
+
+
+def resnet18_thin(num_classes: int = 10, **kw) -> ResNet:
+    """Small variant for tests/CI."""
+    return ResNet(stage_sizes=(1, 1), width=8, num_classes=num_classes, **kw)

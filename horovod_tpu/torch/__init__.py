@@ -1,0 +1,257 @@
+"""PyTorch binding: Horovod's torch API surface on the TPU-native runtime.
+
+† ``horovod/torch/__init__.py`` + ``optimizer.py`` + ``mpi_ops_v2.cc``:
+``hvd.allreduce(tensor)``, ``*_async_`` + ``synchronize``,
+``DistributedOptimizer`` (per-parameter grad hooks → async allreduce,
+``step()`` synchronizes), ``broadcast_parameters`` /
+``broadcast_optimizer_state``.
+
+Topology: one process per rank, as in the reference (launch with
+``hvdrun -np N``).  Each process's torch tensors are that rank's data; the
+bridge is zero-ceremony (torch CPU tensor ↔ numpy ↔ per-rank jax array via
+``from_local``).  Single-process mode treats the process's tensor as
+present on each of its devices (so Sum multiplies by ``local_size`` exactly
+as N identical ranks would).
+
+On TPU VM deployments the torch compute itself stays on CPU (or torch-xla
+where available); the collectives ride the XLA data plane either way.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional
+
+import numpy as np
+import torch
+
+import horovod_tpu as _hvd
+from horovod_tpu import (  # noqa: F401  (re-exported basics †basics.py)
+    Average,
+    Sum,
+    Min,
+    Max,
+    Product,
+    Adasum,
+    ReduceOp,
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+)
+from horovod_tpu.ops.compression import Compression  # noqa: F401
+
+
+def _to_per_rank(t: torch.Tensor):
+    arr = t.detach().cpu().numpy()
+    reps = _hvd.local_size()
+    return _hvd.from_local(np.repeat(arr[None], reps, axis=0))
+
+
+def _from_result(x, like: torch.Tensor) -> torch.Tensor:
+    out = torch.from_numpy(np.array(_hvd.to_numpy(x)))
+    return out.to(dtype=like.dtype)
+
+
+# -- eager verbs --
+
+def allreduce(tensor: torch.Tensor, op: ReduceOp = Average,
+              name: Optional[str] = None) -> torch.Tensor:
+    del name
+    return _from_result(_hvd.allreduce(_to_per_rank(tensor), op), tensor)
+
+
+def allgather(tensor: torch.Tensor, name: Optional[str] = None
+              ) -> torch.Tensor:
+    del name
+    return _from_result(_hvd.allgather(_to_per_rank(tensor)), tensor)
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None) -> torch.Tensor:
+    del name
+    return _from_result(_hvd.broadcast(_to_per_rank(tensor), root_rank),
+                        tensor)
+
+
+def alltoall(tensor: torch.Tensor, splits=None,
+             name: Optional[str] = None) -> torch.Tensor:
+    del name
+    return _from_result(_hvd.alltoall(_to_per_rank(tensor), splits), tensor)
+
+
+# -- async verbs († *_async_ + HandleManager) --
+
+def allreduce_async(tensor: torch.Tensor, op: ReduceOp = Average,
+                    name: Optional[str] = None):
+    return _hvd.allreduce_async(_to_per_rank(tensor), op, name=name)
+
+
+def synchronize(handle) -> torch.Tensor:
+    result = _hvd.synchronize(handle)
+    return torch.from_numpy(np.array(_hvd.to_numpy(result)))
+
+
+def poll(handle) -> bool:
+    return _hvd.poll(handle)
+
+
+# -- parameter/optimizer sync --
+
+def broadcast_parameters(params: Any, root_rank: int = 0) -> None:
+    """In-place broadcast of a ``state_dict`` or named-parameter iterable
+    († ``broadcast_parameters``)."""
+    if isinstance(params, dict):
+        items = list(params.items())
+    else:
+        items = list(params)
+    tensors = {k: v.detach().cpu().numpy() for k, v in items
+               if isinstance(v, torch.Tensor)}
+    synced = _hvd.broadcast_parameters(tensors, root_rank=root_rank)
+    for k, v in items:
+        if isinstance(v, torch.Tensor):
+            with torch.no_grad():
+                v.copy_(torch.from_numpy(np.array(_hvd.to_numpy(synced[k])))
+                        .to(dtype=v.dtype))
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """† ``broadcast_optimizer_state`` — sync optimizer tensor state."""
+    for group in optimizer.param_groups:
+        for p in group["params"]:
+            state = optimizer.state.get(p, {})
+            for key, val in list(state.items()):
+                if isinstance(val, torch.Tensor):
+                    synced = _hvd.broadcast_parameters(
+                        {key: val.detach().cpu().numpy()},
+                        root_rank=root_rank)
+                    with torch.no_grad():
+                        val.copy_(torch.from_numpy(
+                            np.array(_hvd.to_numpy(synced[key])))
+                            .to(dtype=val.dtype))
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """† ``horovod/torch/optimizer.py _DistributedOptimizer``: grad hooks
+    enqueue async allreduces during backward; ``step()`` synchronizes and
+    applies averaged gradients."""
+
+    def __init__(self, optimizer: torch.optim.Optimizer,
+                 named_parameters=None,
+                 op: ReduceOp = Average,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1) -> None:
+        self._inner = optimizer
+        self.op = op
+        self._compression = compression
+        self._bpps = backward_passes_per_step
+        self._pass_counts: dict = {}
+        self._handles: dict = {}
+        self._ctxs: dict = {}
+        if named_parameters is not None:
+            names = {id(p): n for n, p in named_parameters}
+        else:
+            names = {}
+        self._names = names
+        self._hook_handles = []
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._hook_handles.append(
+                        p.register_post_accumulate_grad_hook(
+                            self._make_hook(p)))
+
+    # expose the inner optimizer's surface
+    @property
+    def param_groups(self):
+        return self._inner.param_groups
+
+    @param_groups.setter
+    def param_groups(self, value):
+        self._inner.param_groups = value
+
+    @property
+    def state(self):
+        return self._inner.state
+
+    def _name_of(self, p: torch.Tensor) -> str:
+        return self._names.get(id(p), f"param.{id(p)}")
+
+    def _make_hook(self, p: torch.Tensor):
+        def hook(param: torch.Tensor) -> None:
+            # Local gradient aggregation († backward_passes_per_step): torch
+            # accumulates into p.grad across backwards; the collective fires
+            # only on the Nth pass, carrying the accumulated sum / N.
+            count = self._pass_counts.get(p, 0) + 1
+            self._pass_counts[p] = count
+            if count < self._bpps:
+                return
+            self._pass_counts[p] = 0
+            if p in self._handles:
+                raise RuntimeError(
+                    f"gradient for {self._name_of(p)} reduced twice before "
+                    "step() — call step() once per backward "
+                    "(† duplicate in-flight name check)")
+            grad = param.grad
+            arr = grad.detach().cpu().numpy()
+            if self._bpps > 1:
+                arr = arr / self._bpps
+            import jax.numpy as jnp
+            wire, ctx = self._compression.compress(jnp.asarray(arr))
+            handle = _hvd.allreduce_async(
+                _hvd.from_local(np.repeat(np.asarray(wire)[None],
+                                          _hvd.local_size(), axis=0)),
+                self.op, name=f"grad.{self._name_of(p)}")
+            self._handles[p] = handle
+            self._ctxs[p] = (ctx, grad.dtype)
+        return hook
+
+    def synchronize(self) -> None:
+        """† ``synchronize()``: block on all outstanding grad reductions and
+        write results back into ``p.grad``."""
+        for p, handle in self._handles.items():
+            result = _hvd.synchronize(handle)
+            ctx, dtype = self._ctxs[p]
+            result = self._compression.decompress(result, ctx)
+            with torch.no_grad():
+                p.grad.copy_(torch.from_numpy(
+                    np.array(_hvd.to_numpy(result))).to(dtype=dtype))
+        self._handles.clear()
+        self._ctxs.clear()
+
+    def step(self, closure=None):
+        if self._bpps > 1 and any(self._pass_counts.values()):
+            raise RuntimeError(
+                f"step() called after "
+                f"{max(self._pass_counts.values())} backward passes; "
+                f"backward_passes_per_step={self._bpps} requires exactly "
+                f"{self._bpps} († optimizer.step() assertion)")
+        self.synchronize()
+        return self._inner.step(closure)
+
+    def zero_grad(self, set_to_none: bool = True):
+        return self._inner.zero_grad(set_to_none=set_to_none)
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def load_state_dict(self, sd):
+        return self._inner.load_state_dict(sd)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         op: ReduceOp = Average,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1
+                         ) -> _DistributedOptimizer:
+    """† ``hvd.DistributedOptimizer`` for torch."""
+    return _DistributedOptimizer(
+        optimizer, named_parameters=named_parameters, op=op,
+        compression=compression,
+        backward_passes_per_step=backward_passes_per_step)

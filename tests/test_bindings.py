@@ -1,0 +1,178 @@
+"""Framework bindings: torch eager verbs + DistributedOptimizer, Keras
+callbacks.
+
+Mirrors † ``test/parallel/test_torch.py`` (allreduce/broadcast semantics,
+DistributedOptimizer grad averaging, backward_passes_per_step) and
+† ``test/parallel/test_keras.py`` (callback behavior).
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import horovod_tpu as hvd
+import horovod_tpu.torch as hvd_torch
+
+N = 8  # fake devices; single process drives all → tensors tile across ranks
+
+
+# ---------------------------------------------------------------------------
+# torch eager verbs
+# ---------------------------------------------------------------------------
+
+def test_torch_allreduce_sum_tiles_local_ranks():
+    t = torch.arange(4, dtype=torch.float32)
+    out = hvd_torch.allreduce(t, hvd.Sum)
+    # Single process drives all 8 ranks with the same tensor.
+    assert torch.allclose(out, t * N)
+
+
+def test_torch_allreduce_average_identity():
+    t = torch.randn(3, 3)
+    out = hvd_torch.allreduce(t, hvd.Average)
+    assert torch.allclose(out, t, atol=1e-6)
+
+
+def test_torch_broadcast():
+    t = torch.full((2, 2), 7.0)
+    out = hvd_torch.broadcast(t, root_rank=3)
+    assert torch.allclose(out, t)
+
+
+def test_torch_async_roundtrip():
+    t = torch.ones(5)
+    h = hvd_torch.allreduce_async(t, hvd.Sum, name="torch.async")
+    assert hvd_torch.synchronize(h).shape == (5,)
+
+
+def test_torch_broadcast_parameters_inplace():
+    model = torch.nn.Linear(4, 2)
+    before = {k: v.clone() for k, v in model.state_dict().items()}
+    hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+    for k, v in model.state_dict().items():
+        assert torch.allclose(v, before[k], atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# torch DistributedOptimizer
+# ---------------------------------------------------------------------------
+
+def _train_once(bpps=1, micro_batches=1):
+    torch.manual_seed(0)
+    model = torch.nn.Linear(4, 1)
+    opt = hvd_torch.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+        backward_passes_per_step=bpps)
+    x = torch.randn(8, 4)
+    y = torch.randn(8, 1)
+    opt.zero_grad()
+    for _ in range(micro_batches):
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+    opt.step()
+    return model, opt
+
+
+def test_torch_optimizer_step_applies_averaged_grads():
+    torch.manual_seed(0)
+    ref_model = torch.nn.Linear(4, 1)
+    ref_opt = torch.optim.SGD(ref_model.parameters(), lr=0.1)
+    x = torch.randn(8, 4)
+    y = torch.randn(8, 1)
+    ref_opt.zero_grad()
+    torch.nn.functional.mse_loss(ref_model(x), y).backward()
+    ref_opt.step()
+
+    model, _ = _train_once()
+    # Identical data on every rank → average == local grad → same result
+    # as plain SGD († test_horovod_allreduce_average consistency).
+    for p_ref, p in zip(ref_model.parameters(), model.parameters()):
+        assert torch.allclose(p_ref, p, atol=1e-5)
+
+
+def test_torch_optimizer_backward_passes_per_step():
+    model, opt = _train_once(bpps=3, micro_batches=3)
+    for p in model.parameters():
+        assert p.grad is not None
+
+
+def test_torch_optimizer_step_too_early_raises():
+    torch.manual_seed(0)
+    model = torch.nn.Linear(2, 1)
+    opt = hvd_torch.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        backward_passes_per_step=2)
+    loss = model(torch.randn(3, 2)).sum()
+    loss.backward()
+    with pytest.raises(RuntimeError, match="backward_passes_per_step"):
+        opt.step()
+
+
+# ---------------------------------------------------------------------------
+# Keras callbacks
+# ---------------------------------------------------------------------------
+
+keras = pytest.importorskip("keras")
+
+
+def _tiny_keras_model():
+    model = keras.Sequential([
+        keras.layers.Input((4,)),
+        keras.layers.Dense(2),
+    ])
+    model.compile(optimizer=keras.optimizers.SGD(0.1), loss="mse")
+    return model
+
+
+def test_keras_broadcast_callback_preserves_weights():
+    import horovod_tpu.keras as hvd_keras
+    model = _tiny_keras_model()
+    before = [w.copy() for w in model.get_weights()]
+    cb = hvd_keras.BroadcastGlobalVariablesCallback(0)
+    cb.set_model(model)
+    cb.on_train_begin()
+    for b, a in zip(before, model.get_weights()):
+        np.testing.assert_allclose(b, a, atol=1e-6)
+
+
+def test_keras_metric_average_callback():
+    import horovod_tpu.keras as hvd_keras
+    cb = hvd_keras.MetricAverageCallback()
+    logs = {"loss": 2.0, "acc": 0.5}
+    cb.on_epoch_end(0, logs)
+    # Identical on every rank → average is identity.
+    assert logs["loss"] == pytest.approx(2.0)
+    assert logs["acc"] == pytest.approx(0.5)
+
+
+def test_keras_warmup_callback_ramps_lr():
+    import horovod_tpu.keras as hvd_keras
+    model = _tiny_keras_model()
+    cb = hvd_keras.LearningRateWarmupCallback(
+        initial_lr=0.1, warmup_epochs=1, multiplier=8.0, steps_per_epoch=10)
+    cb.set_model(model)
+    cb.on_train_begin()
+    lrs = []
+    for step in range(10):
+        cb.on_train_batch_begin(step)
+        lrs.append(float(np.asarray(model.optimizer.learning_rate)))
+    assert lrs[0] == pytest.approx(0.1)
+    assert lrs[-1] > lrs[0]
+    cb.on_train_batch_begin(10)
+    assert float(np.asarray(model.optimizer.learning_rate)) == \
+        pytest.approx(0.8)
+
+
+def test_keras_schedule_callback():
+    import horovod_tpu.keras as hvd_keras
+    model = _tiny_keras_model()
+    cb = hvd_keras.LearningRateScheduleCallback(
+        initial_lr=0.1, multiplier=lambda e: 0.1 ** e, start_epoch=1)
+    cb.set_model(model)
+    cb.on_epoch_begin(0)   # before start: untouched
+    lr0 = float(np.asarray(model.optimizer.learning_rate))
+    cb.on_epoch_begin(2)
+    lr2 = float(np.asarray(model.optimizer.learning_rate))
+    assert lr0 == pytest.approx(0.1)
+    assert lr2 == pytest.approx(0.001)

@@ -99,3 +99,12 @@ def test_hvdrun_no_command():
         capture_output=True, text=True, timeout=60, cwd=REPO)
     assert res.returncode == 2
     assert "no command" in res.stderr
+
+
+@pytest.mark.integration
+def test_hvdrun_torch_distributed_optimizer():
+    """†3.2: the torch hot path over 2 real processes with different data."""
+    res = _hvdrun(2, [os.path.join(REPO, "tests", "mp_torch_worker.py")])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "rank 0: TORCH-OK" in res.stdout
+    assert "rank 1: TORCH-OK" in res.stdout
