@@ -1,0 +1,86 @@
+"""Collective microbenchmarks: allreduce/allgather/alltoall bus bandwidth.
+
+The BASELINE metric: "allreduce bus bandwidth >= 90% of ICI peak on
+v5p-64".  Bus bandwidth uses the standard (NCCL-tests) accounting — for a
+ring allreduce each device moves ``2*(N-1)/N * bytes`` on the wire
+(† ``docs/concepts.rst`` ring cost model), so
+
+    busbw = (2*(N-1)/N) * payload_bytes / time        (allreduce)
+    busbw = ((N-1)/N)   * payload_bytes / time        (allgather/alltoall/rs)
+
+Run directly (``python -m benchmarks.collective_bench``) for a sweep table,
+or call :func:`allreduce_busbw` for one point.  On a single chip there is
+no inter-chip wire; the sweep still validates dispatch overhead and HBM
+throughput, and the same harness scales to any mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def _fence(x) -> None:
+    # device->host readback: block_until_ready can be a no-op on tunneled
+    # backends (see bench.py), so fetch one element to fence.
+    np.asarray(jax_device_get_first(x))
+
+
+def jax_device_get_first(x):
+    import jax
+    return jax.device_get(x.ravel()[0] if hasattr(x, "ravel") else x)
+
+
+def allreduce_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
+                    dtype="float32") -> dict:
+    """One allreduce bandwidth point on the current global mesh."""
+    import jax
+    import jax.numpy as jnp
+    import horovod_tpu as hvd
+
+    n = hvd.size()
+    itemsize = np.dtype(dtype).itemsize
+    numel = max(1, nbytes // itemsize)
+    x = hvd.per_rank_from_fn(
+        lambda r: np.full((numel,), float(r + 1), dtype))
+    from horovod_tpu.ops import collectives as C
+    out = C.allreduce(x, hvd.Sum)
+    _fence(out)
+    for _ in range(warmup):
+        out = C.allreduce(x, hvd.Sum)
+    _fence(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = C.allreduce(x, hvd.Sum)
+    _fence(out)
+    dt = (time.perf_counter() - t0) / iters
+    payload = numel * itemsize
+    algbw = payload / dt
+    busbw = algbw * (2 * (n - 1) / n) if n > 1 else algbw
+    return {"op": "allreduce", "bytes": payload, "time_us": dt * 1e6,
+            "algbw_GBs": algbw / 1e9, "busbw_GBs": busbw / 1e9, "ranks": n}
+
+
+def sweep(sizes=None, **kw) -> list[dict]:
+    if sizes is None:
+        sizes = [1 << p for p in range(12, 27, 2)]   # 4 KB .. 64 MB
+    return [allreduce_busbw(s, **kw) for s in sizes]
+
+
+def main() -> None:
+    import horovod_tpu as hvd
+    hvd.init()
+    rows = sweep()
+    for r in rows:
+        print(json.dumps(r))
+    best = max(rows, key=lambda r: r["busbw_GBs"])
+    print(json.dumps({"metric": "allreduce_busbw_peak", "value":
+                      round(best["busbw_GBs"], 2), "unit": "GB/s",
+                      "at_bytes": best["bytes"], "ranks": best["ranks"]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -226,6 +226,36 @@ def alltoall_async(x: Any, splits: Optional[Sequence[int]] = None, *,
     return _engine().enqueue(entry)
 
 
+def grouped_allreduce_async(xs: Sequence[Any], op: ReduceOp = Average, *,
+                            name: Optional[str] = None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            process_set=None) -> list[Handle]:
+    """Enqueue several allreduces at once († ``hvd.grouped_allreduce_async``,
+    v0.21).  The entries share one engine cycle, so they fuse into a single
+    compiled collective (subject to the fusion threshold)."""
+    base = _auto_name("grouped", name)
+    handles = []
+    eng = _engine()
+    for i, x in enumerate(xs):
+        entry = TensorTableEntry(
+            name=f"{base}.{i}", verb="allreduce",
+            payload=_C.as_per_rank(x, process_set), op=op,
+            prescale=prescale_factor, postscale=postscale_factor,
+            process_set=process_set)
+        handles.append(eng.enqueue(entry))
+    return handles
+
+
+def grouped_allreduce_sync(xs: Sequence[Any], op: ReduceOp = Average,
+                           **kw) -> list:
+    """† ``hvd.grouped_allreduce``: fused sync variant."""
+    handles = grouped_allreduce_async(xs, op, **kw)
+    if handles:
+        _engine().nudge()
+    return [h.wait() for h in handles]
+
+
 def reducescatter_async(x: Any, op: ReduceOp = Sum, *,
                         name: Optional[str] = None, process_set=None) -> Handle:
     entry = TensorTableEntry(

@@ -21,6 +21,7 @@ same as the reference's host-RAM ``TorchState`` copies.
 """
 
 from .state import State, ObjectState, JaxState  # noqa: F401
+from .sampler import ElasticSampler  # noqa: F401
 from .runner import (  # noqa: F401
     HostsUpdatedInterrupt,
     WorkerNotificationClient,

@@ -1,0 +1,172 @@
+"""Auxiliary subsystems: checkpoint/resume, elastic sampler, join, grouped
+async, autotuner unit behavior.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+
+# ---------------------------------------------------------------------------
+# checkpoint (orbax)
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_save_restore_roundtrip(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu.utils.checkpoint import Checkpointer
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    tree = {"params": {"w": jnp.arange(8.0), "b": jnp.ones((3,))},
+            "step": jnp.int32(7)}
+    ckpt.save(7, tree)
+    assert ckpt.latest_step() == 7
+    restored = ckpt.restore()
+    np.testing.assert_allclose(np.asarray(restored["params"]["w"]),
+                               np.arange(8.0))
+    assert int(restored["step"]) == 7
+    ckpt.close()
+
+
+def test_checkpoint_resharded_restore(tmp_path):
+    """Restore onto an explicit sharding target — the elastic-restart path
+    (new mesh after membership change)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from horovod_tpu.utils.checkpoint import Checkpointer
+    mesh = hvd.mesh()
+    ckpt = Checkpointer(str(tmp_path / "ck2"))
+    tree = {"w": jnp.arange(16.0)}
+    ckpt.save(0, tree)
+    target = {"w": jax.ShapeDtypeStruct(
+        (16,), jnp.float32, sharding=NamedSharding(mesh, P("hvd")))}
+    restored = ckpt.restore(target=target)
+    assert restored["w"].sharding == NamedSharding(mesh, P("hvd"))
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.arange(16.0))
+    ckpt.close()
+
+
+def test_checkpoint_max_to_keep(tmp_path):
+    import jax.numpy as jnp
+    from horovod_tpu.utils.checkpoint import Checkpointer
+    ckpt = Checkpointer(str(tmp_path / "ck3"), max_to_keep=2)
+    for s in range(4):
+        ckpt.save(s, {"x": jnp.float32(s)})
+    assert ckpt.all_steps() == [2, 3]
+    ckpt.close()
+
+
+def test_checkpoint_restore_missing(tmp_path):
+    from horovod_tpu.utils.checkpoint import Checkpointer
+    ckpt = Checkpointer(str(tmp_path / "empty"))
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore()
+    ckpt.close()
+
+
+# ---------------------------------------------------------------------------
+# elastic sampler († test_torch_elastic.py sampler cases)
+# ---------------------------------------------------------------------------
+
+def test_sampler_shards_evenly():
+    from horovod_tpu.elastic import ElasticSampler
+    samplers = []
+    for r in range(4):
+        s = ElasticSampler(100, shuffle=False)
+        s.set_rank_size(r, 4)
+        samplers.append(list(s))
+    all_idx = sorted(i for s in samplers for i in s)
+    assert all_idx == list(range(100))
+    assert all(len(s) == 25 for s in samplers)
+
+
+def test_sampler_reshards_remaining_after_membership_change():
+    from horovod_tpu.elastic import ElasticSampler
+    s = ElasticSampler(20, shuffle=False)
+    s.set_rank_size(0, 2)
+    first_half = list(s)[:5]
+    s.record_batch(first_half)
+    # World shrinks to 1: remaining indices = all except processed.
+    s.set_rank_size(0, 1)
+    remaining = list(s)
+    assert set(remaining) == set(range(20)) - set(first_half)
+
+
+def test_sampler_epoch_resets_progress():
+    from horovod_tpu.elastic import ElasticSampler
+    s = ElasticSampler(10, shuffle=True, seed=1)
+    s.record_batch([0, 1, 2])
+    s.set_epoch(1)
+    assert len(s) == 10
+    # Shuffle differs across epochs.
+    e1 = list(s)
+    s.set_epoch(2)
+    assert list(s) != e1
+
+
+def test_sampler_state_dict_roundtrip():
+    from horovod_tpu.elastic import ElasticSampler
+    s = ElasticSampler(10, shuffle=False)
+    s.record_batch([1, 3])
+    sd = s.state_dict()
+    s2 = ElasticSampler(10, shuffle=False)
+    s2.load_state_dict(sd)
+    assert set(s2) == set(range(10)) - {1, 3}
+
+
+# ---------------------------------------------------------------------------
+# join + grouped async
+# ---------------------------------------------------------------------------
+
+def test_join_returns_last_rank():
+    assert hvd.join() == hvd.size() - 1
+
+
+def test_grouped_allreduce_async():
+    xs = [hvd.per_rank_from_fn(
+        lambda r, i=i: np.full((4,), float(r + i), np.float32))
+        for i in range(3)]
+    handles = hvd.grouped_allreduce_async(xs, hvd.Average, name="grp")
+    for i, h in enumerate(handles):
+        got = hvd.to_numpy(hvd.synchronize(h))
+        np.testing.assert_allclose(got, np.full((4,), 3.5 + i), rtol=1e-6)
+
+
+def test_grouped_allreduce_sync():
+    xs = [hvd.per_rank_from_fn(
+        lambda r, i=i: np.full((2,), float(r * i), np.float32))
+        for i in range(2)]
+    outs = hvd.grouped_allreduce_sync(xs, hvd.Sum)
+    np.testing.assert_allclose(hvd.to_numpy(outs[0]), 0.0)
+    np.testing.assert_allclose(hvd.to_numpy(outs[1]), np.full((2,), 28.0))
+
+
+# ---------------------------------------------------------------------------
+# autotuner unit behavior († parameter_manager tests)
+# ---------------------------------------------------------------------------
+
+def test_autotuner_proposes_and_converges(tmp_path):
+    from horovod_tpu.utils.autotune import Autotuner
+
+    class FakeState:
+        pass
+
+    from horovod_tpu import config as config_mod
+    st = FakeState()
+    st.config = config_mod.Config(
+        autotune=True, autotune_log=str(tmp_path / "at.log"),
+        autotune_warmup_samples=1, autotune_steps_per_sample=2)
+    at = Autotuner(st)
+    # Feed cycles: throughput peaks at larger thresholds.
+    for i in range(200):
+        if at._done:
+            break
+        t, c = at._current
+        score_bias = 1.0 + (np.log2(t) - 20) * 0.1
+        at.record_cycle(int(1e6 * score_bias), 0.001)
+    log = (tmp_path / "at.log").read_text()
+    assert "sample #" in log
+    # Knobs were mutated by the proposals.
+    assert (st.config.fusion_threshold, st.config.cycle_time_ms) != (
+        64 * 1024 * 1024, 5.0) or at._done
