@@ -159,12 +159,21 @@ def reducescatter(x: Any, op: ReduceOp = Sum, process_set=None) -> Any:
 def barrier(process_set=None) -> None:
     """Block until all ranks arrive († ``hvd.barrier``)."""
     import numpy as _np
+    import jax as _jax
     state = global_state()
     if state.initialized and state.engine is not None \
             and state.engine.distributed:
         n = process_set.size() if process_set is not None else size()
+        if process_set is not None:
+            me = _jax.process_index()
+            my_rows = sum(1 for d in process_set.mesh.devices.flat
+                          if d.process_index == me)
+            if my_rows == 0:
+                return  # this process owns no ranks in the set
+        else:
+            my_rows = local_size()
         ones = _C.from_local(
-            _np.ones((local_size(), ), _np.int32)[:, None], process_set)
+            _np.ones((my_rows, ), _np.int32)[:, None], process_set)
         entry = TensorTableEntry(
             name=_auto_name("barrier", None), verb="allreduce",
             payload=ones, op=Sum, process_set=process_set)

@@ -79,6 +79,10 @@ class Config:
     # On TPU: two-level = ICI within a slice + DCN across slices.
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
+    # ICI-group size for the two-level split (ranks per slice).  Default:
+    # this process's device count (= one host's chips), the analogue of the
+    # reference's "local ranks per node".
+    hierarchical_local_size: Optional[int] = None
 
     # --- elastic († runner/elastic) ---
     elastic: bool = False
@@ -120,6 +124,7 @@ _ENV_TABLE = [
     ("log_hide_timestamp", "LOG_HIDE_TIME", _parse_bool),
     ("hierarchical_allreduce", "HIERARCHICAL_ALLREDUCE", _parse_bool),
     ("hierarchical_allgather", "HIERARCHICAL_ALLGATHER", _parse_bool),
+    ("hierarchical_local_size", "HIERARCHICAL_LOCAL_SIZE", int),
     ("elastic", "ELASTIC", _parse_bool),
     ("coordinator_addr", "COORDINATOR_ADDR", str),
     ("controller_addr", "CONTROLLER_ADDR", str),

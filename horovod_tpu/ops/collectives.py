@@ -197,10 +197,13 @@ def from_local(x: Any, process_set=None) -> jax.Array:
 
 
 def to_local(x: jax.Array) -> np.ndarray:
-    """Rows of a per-rank/replicated result owned by this process's devices."""
-    if jax.process_count() == 1:
+    """Rows of a per-rank result owned by this process's devices; replicated
+    results return the single full copy (every local shard is identical)."""
+    if jax.process_count() == 1 or x.sharding.is_fully_replicated:
+        # Replicated: each addressable shard holds the full array — return
+        # one copy, not one per local device.
         return to_numpy(x)
-    shards = [s for s in x.addressable_shards]
+    shards = list(x.addressable_shards)
     shards.sort(key=lambda s: s.index)
     return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
 
@@ -244,15 +247,21 @@ def _build_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
 def _build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
                              numels: tuple[int, ...],
                              shapes: tuple[tuple[int, ...], ...],
-                             prescale: float, postscale: float):
+                             prescale: float, postscale: float,
+                             hier: Optional[tuple[int, int]] = None):
     """One fused program for many tensors: flatten → concat → reduce → split.
 
     This *is* the fusion buffer († ``fusion_buffer_manager.cc``): instead of
     memcpying into a 64 MB scratch allocation, the flatten/concat lives inside
     the compiled program where XLA fuses it with the collective, and HBM
-    layout is the compiler's problem.
+    layout is the compiler's problem.  With ``hier`` set, the fused buffer
+    rides the two-level path.
     """
-    reduce_one = _build_allreduce(mesh, axis, op, prescale, postscale)
+    if hier is not None:
+        reduce_one = _build_hier_allreduce(
+            ctx_mod.global_state(), op, hier[0], hier[1], prescale, postscale)
+    else:
+        reduce_one = _build_allreduce(mesh, axis, op, prescale, postscale)
 
     def fused(xs):
         n = xs[0].shape[0]
@@ -324,6 +333,45 @@ def _build_reducescatter(mesh: Mesh, axis: str, op: ReduceOp):
     return jax.jit(fn)
 
 
+def _hier_split(process_set) -> Optional[tuple[int, int]]:
+    """(n_cross, n_local) when two-level allreduce is enabled and valid
+    († HOROVOD_HIERARCHICAL_ALLREDUCE gate in nccl_operations.cc)."""
+    if process_set is not None:
+        return None  # subgroup topology unknown; flat path
+    state = ctx_mod.global_state()
+    cfg = state.config
+    if not cfg.hierarchical_allreduce:
+        return None
+    n = state.size
+    n_local = cfg.hierarchical_local_size or state.local_size
+    if n_local <= 1 or n_local >= n or n % n_local:
+        return None
+    return (n // n_local, n_local)
+
+
+def _build_hier_allreduce(state, op: ReduceOp, n_cross: int, n_local: int,
+                          prescale: float, postscale: float):
+    from . import hierarchical as H
+    devices = np.array(list(state.devices)).reshape(n_cross, n_local)
+    mesh2 = Mesh(devices, ("hvd_cross", "hvd_local"))
+
+    def kernel(v):  # [1, *shape] per device
+        x = v[0]
+        if prescale != 1.0:
+            x = x * jnp.asarray(prescale, x.dtype)
+        out = H.hierarchical_allreduce_local(
+            x, local_axis="hvd_local", cross_axis="hvd_cross",
+            average=(op is ReduceOp.AVERAGE))
+        if postscale != 1.0:
+            out = out * jnp.asarray(postscale, out.dtype)
+        return out
+
+    fn = shard_map(kernel, mesh=mesh2,
+                   in_specs=P(("hvd_cross", "hvd_local")),
+                   out_specs=P(), check_vma=False)
+    return jax.jit(fn)
+
+
 # ---------------------------------------------------------------------------
 # Public verbs
 # ---------------------------------------------------------------------------
@@ -345,6 +393,21 @@ def allreduce(x: Any, op: ReduceOp = ReduceOp.AVERAGE, *,
         return adasum.adasum_allreduce(x, process_set=process_set)
     mesh, axis = _mesh_axis(process_set)
     x = as_per_rank(x, process_set)
+    split = _hier_split(process_set)
+    if split is not None and (
+            op is ReduceOp.SUM
+            or (op is ReduceOp.AVERAGE
+                and jnp.issubdtype(x.dtype, jnp.floating))):
+        n_cross, n_local = split
+        state = ctx_mod.global_state()
+        key = _sig(mesh, axis, "hier_allreduce", op, x.dtype.name, x.shape,
+                   n_cross, n_local,
+                   float(prescale_factor), float(postscale_factor))
+        fn = _cache.get_or_build(
+            key, lambda: _build_hier_allreduce(
+                state, op, n_cross, n_local,
+                float(prescale_factor), float(postscale_factor)))
+        return fn(x)
     key = _sig(mesh, axis, "allreduce", op, x.dtype.name, x.shape,
                float(prescale_factor), float(postscale_factor))
     fn = _cache.get_or_build(
@@ -380,16 +443,21 @@ def grouped_allreduce(xs: Sequence[Any], op: ReduceOp = ReduceOp.AVERAGE, *,
             for i, r in zip(idxs, sub):
                 out[i] = r
         return out  # type: ignore[return-value]
-    n = mesh.shape[axis]
     shapes = tuple(a.shape[1:] for a in arrs)
     numels = tuple(int(np.prod(s, dtype=np.int64)) if s else 1 for s in shapes)
+    hier = _hier_split(process_set)
+    if hier is not None and not (
+            op is ReduceOp.SUM
+            or (op is ReduceOp.AVERAGE
+                and jnp.issubdtype(arrs[0].dtype, jnp.floating))):
+        hier = None
     key = _sig(mesh, axis, "grouped_allreduce", op, arrs[0].dtype.name,
-               numels, shapes, float(prescale_factor), float(postscale_factor))
+               numels, shapes, hier,
+               float(prescale_factor), float(postscale_factor))
     fn = _cache.get_or_build(
         key, lambda: _build_grouped_allreduce(
             mesh, axis, op, numels, shapes,
-            float(prescale_factor), float(postscale_factor)))
-    del n
+            float(prescale_factor), float(postscale_factor), hier=hier))
     return list(fn(arrs))
 
 

@@ -256,7 +256,20 @@ class CollectiveEngine:
         t0 = time.monotonic()
         entries = [e for e, _ in batch]
         handles = {id(e): h for e, h in batch}
-        ready = self._negotiator.negotiate(entries)
+        try:
+            ready = self._negotiator.negotiate(entries)
+        except Exception as err:
+            # Negotiation transport failure (controller died, TCP error):
+            # fail every handle in the batch so waiters raise instead of
+            # hanging († error Response to all ranks; elastic catches the
+            # resulting HorovodInternalError and re-rendezvouses).
+            for e, h in batch:
+                with self._lock:
+                    self._names_pending.discard(e.name)
+                h._complete(error=err)
+            log.error("negotiation failed; %d collectives errored: %s",
+                      len(batch), err)
+            return
         ready_ids = {id(e) for e in ready}
         deferred = [(e, h) for e, h in batch if id(e) not in ready_ids]
         if deferred:

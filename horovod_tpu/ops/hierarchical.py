@@ -17,8 +17,12 @@ hierarchical mode matters for multislice meshes; the mesh builder puts the
 slice boundary on the outer axes (see parallel/mesh.py) and this module
 provides the explicit two-level lowering plus a flat fallback.
 
-Enabled per-call or via ``HVDTPU_HIERARCHICAL_ALLREDUCE`` (reference env
-parity); the engine consults the flag when fusing allreduce batches.
+Enabled via ``HVDTPU_HIERARCHICAL_ALLREDUCE`` (+ optional
+``HVDTPU_HIERARCHICAL_LOCAL_SIZE`` for the ICI-group size, defaulting to
+this process's device count): ``ops/collectives.allreduce`` and the fused
+``grouped_allreduce`` route SUM/AVERAGE reductions through the two-level
+kernel when the split is valid, including batches fused by the engine.
+The standalone entries below also work directly on explicit 2-D meshes.
 """
 
 from __future__ import annotations

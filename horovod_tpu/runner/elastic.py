@@ -156,8 +156,17 @@ class ElasticDriver:
                 spec = ",".join(f"{h.hostname}:{h.slots}" for h in hosts)
                 np_total = min(sum(h.slots for h in hosts),
                                self.max_np or 10 ** 9)
-                return launch_workers(cmd, np_total=np_total,
-                                      hosts_spec=spec, extra_env=env)
+                failure: dict = {}
+                code = launch_workers(cmd, np_total=np_total,
+                                      hosts_spec=spec, extra_env=env,
+                                      failure_info=failure)
+                if code != 0 and failure.get("host") and len(hosts) > 1:
+                    # † registration.py: exclude the crashed worker's host
+                    # from the next assignment.  Sole-host jobs keep their
+                    # host (blacklisting it would make relaunch impossible;
+                    # transient failures get the retry instead).
+                    self.blacklist(failure["host"])
+                return code
 
         restarts = 0
         while True:

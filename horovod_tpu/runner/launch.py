@@ -128,7 +128,8 @@ def launch_workers(command: Sequence[str], *, np_total: int,
                    extra_env: Optional[dict] = None,
                    ssh_port: int = 22,
                    verbose: bool = False,
-                   prefix_output: bool = True) -> int:
+                   prefix_output: bool = True,
+                   failure_info: Optional[dict] = None) -> int:
     """Start services + workers; wait; return exit code.  Local ranks run as
     child processes, remote ranks through ``ssh`` († gloo_run exec path)."""
     from .._native import ControllerServer, KvServer
@@ -144,8 +145,17 @@ def launch_workers(command: Sequence[str], *, np_total: int,
 
     kv = KvServer()
     ctrl = ControllerServer(size=np_total)
-    coord_port = _free_port()
-    coord_host = "127.0.0.1" if is_local_job else assignment[0][1]
+    if is_local_job:
+        coord_port = _free_port()
+        coord_host = "127.0.0.1"
+    else:
+        # The JAX coordinator binds on rank 0's host, which the launcher
+        # cannot probe; pick from a wide ephemeral-range slice to make
+        # collisions unlikely.  (A conflict fails that worker's startup and
+        # the monitor reports it; --start-timeout bounds the wait.)
+        import random
+        coord_port = random.randint(23000, 29999)
+        coord_host = assignment[0][1]
 
     workers: List[_Worker] = []
     failed = threading.Event()
@@ -214,6 +224,14 @@ def launch_workers(command: Sequence[str], *, np_total: int,
                 if rc != 0 and not failed.is_set():
                     failed.set()
                     code = rc
+                    if failure_info is not None:
+                        # First failure only: later nonzero exits are the
+                        # launcher's own SIGTERMs, not independent faults
+                        # († blacklist the host that actually crashed).
+                        host = next(h for r, h, _ in assignment
+                                    if r == rank_id)
+                        failure_info.update(
+                            {"rank": rank_id, "host": host, "code": rc})
                     if verbose:
                         print(f"[launcher] rank {rank_id} exited {rc}; "
                               "terminating remaining workers",

@@ -121,19 +121,27 @@ def broadcast_parameters(params: Any, root_rank: int = 0) -> None:
 
 def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
                               root_rank: int = 0) -> None:
-    """† ``broadcast_optimizer_state`` — sync optimizer tensor state."""
-    for group in optimizer.param_groups:
-        for p in group["params"]:
-            state = optimizer.state.get(p, {})
-            for key, val in list(state.items()):
+    """† ``broadcast_optimizer_state`` — sync optimizer tensor state.
+
+    All state tensors ship in ONE broadcast (a pytree dict), not one
+    collective per tensor — Adam on a large model has thousands of state
+    tensors and per-tensor multihost round-trips would dominate startup.
+    """
+    refs: dict[str, torch.Tensor] = {}
+    for gi, group in enumerate(optimizer.param_groups):
+        for pi, p in enumerate(group["params"]):
+            for key, val in optimizer.state.get(p, {}).items():
                 if isinstance(val, torch.Tensor):
-                    synced = _hvd.broadcast_parameters(
-                        {key: val.detach().cpu().numpy()},
-                        root_rank=root_rank)
-                    with torch.no_grad():
-                        val.copy_(torch.from_numpy(
-                            np.array(_hvd.to_numpy(synced[key])))
-                            .to(dtype=val.dtype))
+                    refs[f"g{gi}.p{pi}.{key}"] = val
+    if not refs:
+        return
+    synced = _hvd.broadcast_parameters(
+        {k: v.detach().cpu().numpy() for k, v in refs.items()},
+        root_rank=root_rank)
+    for k, val in refs.items():
+        with torch.no_grad():
+            val.copy_(torch.from_numpy(np.array(_hvd.to_numpy(synced[k])))
+                      .to(dtype=val.dtype))
 
 
 class _DistributedOptimizer(torch.optim.Optimizer):

@@ -132,3 +132,30 @@ def test_timeline_writes_events(tmp_path):
     events = json.load(open(p))
     names = [e.get("name") for e in events]
     assert "DISPATCH" in names and "CYCLE" in names
+
+
+def test_negotiator_failure_fails_handles():
+    """A negotiation transport failure must error every pending handle
+    rather than hanging waiters (code-review finding)."""
+    from horovod_tpu.ops.engine import Negotiator
+
+    class ExplodingNegotiator(Negotiator):
+        always_check_in = False
+
+        def negotiate(self, entries):
+            raise ConnectionError("controller gone")
+
+    eng = hvd.global_state().engine
+    old = eng._negotiator
+    eng._negotiator = ExplodingNegotiator()
+    try:
+        x = hvd.per_rank([np.ones((2,), np.float32)] * N)
+        h = hvd.allreduce_async(x, name="t.negfail")
+        with pytest.raises(hvd.HorovodInternalError, match="controller gone"):
+            hvd.synchronize(h)
+        # Name must be released so the same tensor can be re-enqueued.
+        eng._negotiator = old
+        h2 = hvd.allreduce_async(x, name="t.negfail")
+        hvd.synchronize(h2)
+    finally:
+        eng._negotiator = old

@@ -64,3 +64,57 @@ def test_collective_bench_harness_runs():
     assert row["ranks"] == 8
     assert row["busbw_GBs"] > 0
     assert row["bytes"] == 1 << 14
+
+
+def test_hierarchical_flag_routes_allreduce():
+    """HVDTPU_HIERARCHICAL_ALLREDUCE wiring: flag + local-size split routes
+    the public allreduce through the two-level kernel with equal results."""
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import collectives as C
+    state = hvd.global_state()
+    old_flag = state.config.hierarchical_allreduce
+    old_ls = state.config.hierarchical_local_size
+    state.config.hierarchical_allreduce = True
+    state.config.hierarchical_local_size = 4   # 2 slices x 4
+    try:
+        assert C._hier_split(None) == (2, 4)
+        parts = [np.random.RandomState(r).randn(33).astype(np.float32)
+                 for r in range(8)]
+        x = hvd.per_rank(parts)
+        got = np.asarray(C.allreduce(x, hvd.Sum))
+        np.testing.assert_allclose(got, np.stack(parts).sum(0),
+                                   rtol=1e-4, atol=1e-5)
+        got_avg = np.asarray(C.allreduce(x, hvd.Average))
+        np.testing.assert_allclose(got_avg, np.stack(parts).mean(0),
+                                   rtol=1e-4, atol=1e-6)
+        # grouped path too
+        outs = C.grouped_allreduce([x, x], hvd.Sum)
+        np.testing.assert_allclose(np.asarray(outs[1]),
+                                   np.stack(parts).sum(0),
+                                   rtol=1e-4, atol=1e-5)
+        # int AVERAGE must stay on the flat path (floor semantics)
+        xi = hvd.per_rank([np.full((3,), r, np.int32) for r in range(8)])
+        gi = np.asarray(C.allreduce(xi, hvd.Average))
+        np.testing.assert_array_equal(gi, np.full((3,), 28 // 8))
+    finally:
+        state.config.hierarchical_allreduce = old_flag
+        state.config.hierarchical_local_size = old_ls
+
+
+def test_hierarchical_split_invalid_cases():
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import collectives as C
+    state = hvd.global_state()
+    old = (state.config.hierarchical_allreduce,
+           state.config.hierarchical_local_size)
+    try:
+        state.config.hierarchical_allreduce = False
+        assert C._hier_split(None) is None
+        state.config.hierarchical_allreduce = True
+        state.config.hierarchical_local_size = 3   # 8 % 3 != 0
+        assert C._hier_split(None) is None
+        state.config.hierarchical_local_size = 8   # == size
+        assert C._hier_split(None) is None
+    finally:
+        (state.config.hierarchical_allreduce,
+         state.config.hierarchical_local_size) = old
