@@ -178,7 +178,8 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 def _attention(q, k, v, mesh: Optional[Mesh], causal: bool) -> jax.Array:
-    """Dispatch dense vs ring attention by the mesh's sp size."""
+    """Dispatch: ring attention when the sequence is sp-sharded; the Pallas
+    flash kernel on TPU for supported shapes; dense XLA otherwise."""
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
     if sp > 1:
         fn = shard_map(
@@ -189,6 +190,10 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool) -> jax.Array:
             axis_names={"sp"},
             check_vma=False)
         return fn(q, k, v)
+    if jax.default_backend() == "tpu":
+        from ..ops import flash_attention as FA
+        if FA.supported(q.shape):
+            return FA.flash_attention(q, k, v, None, causal)
     D = q.shape[-1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D)
     if causal:
