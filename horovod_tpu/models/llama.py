@@ -179,7 +179,10 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 def _attention(q, k, v, mesh: Optional[Mesh], causal: bool) -> jax.Array:
     """Dispatch: ring attention when the sequence is sp-sharded; the Pallas
-    flash kernel on TPU for supported shapes; dense XLA otherwise."""
+    flash kernel on TPU for supported shapes (shard_mapped over the mesh so
+    each chip runs the kernel on its own batch/head shard — a bare
+    pallas_call has no GSPMD partitioning rule and would be replicated);
+    dense XLA otherwise."""
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
     if sp > 1:
         fn = shard_map(
@@ -192,16 +195,24 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool) -> jax.Array:
         return fn(q, k, v)
     if jax.default_backend() == "tpu":
         from ..ops import flash_attention as FA
-        if FA.supported(q.shape):
+        B, S, H, D = q.shape
+        if mesh is not None:
+            dpf = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+            tp = mesh.shape.get("tp", 1)
+            local = (B // max(dpf, 1), S, H // max(tp, 1), D)
+            if (B % dpf == 0 and H % tp == 0
+                    and FA.supported(local, q.dtype.itemsize)):
+                spec = P(("dp", "fsdp"), None, "tp", None)
+                fn = shard_map(
+                    lambda q_, k_, v_: FA.flash_attention(
+                        q_, k_, v_, None, causal),
+                    mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                    check_vma=False)
+                return fn(q, k, v)
+        elif FA.supported(q.shape, q.dtype.itemsize):
             return FA.flash_attention(q, k, v, None, causal)
-    D = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D)
-    if causal:
-        S = q.shape[1]
-        mask = jnp.tril(jnp.ones((S, S), bool))
-        s = jnp.where(mask[None, None], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    from ..ops.flash_attention import dense_attention
+    return dense_attention(q, k, v, 1.0 / np.sqrt(q.shape[-1]), causal)
 
 
 def _moe_mlp(h2, lp, cfg: LlamaConfig, mesh: Optional[Mesh]):

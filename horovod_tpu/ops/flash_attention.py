@@ -34,7 +34,8 @@ import numpy as np
 _NEG_INF = -1e30
 
 
-def _dense_attention(q, k, v, scale, causal):
+def dense_attention(q, k, v, scale, causal):
+    """Dense XLA attention — the fallback path and the test oracle."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         S = q.shape[1]
@@ -91,7 +92,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     l0 = jnp.zeros((block_q,), jnp.float32)
     acc0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
     if causal:
-        upper = jax.lax.min((qi + 1) * block_q // block_k + 1, n_kv)
+        # last needed K block covers query row (qi+1)*block_q - 1
+        upper = jax.lax.min(
+            ((qi + 1) * block_q - 1) // block_k + 1, n_kv)
     else:
         upper = n_kv
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
@@ -170,7 +173,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
 
     if causal:
-        upper = jax.lax.min((qi + 1) * block_q // block_k + 1, n_kv)
+        upper = jax.lax.min(
+            ((qi + 1) * block_q - 1) // block_k + 1, n_kv)
     else:
         upper = n_kv
     dq0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
@@ -304,13 +308,20 @@ def default_blocks(seq_len: int) -> tuple[int, int]:
     return bq or 128, bk or 128
 
 
-def supported(q_shape: tuple) -> bool:
+def supported(q_shape: tuple, itemsize: int = 4) -> bool:
     """Shapes the kernel handles: seq divisible by a block size, D ≤ 256,
-    and K/V fitting VMEM comfortably."""
+    and the heaviest kernel's resident set fitting VMEM.  The budget counts
+    what actually sits in VMEM at once: two full-sequence operands (K/V in
+    the forward, Q/dO in the dkv backward), the lse/delta rows, and the
+    double-buffered fp32 block operands/accumulators."""
     B, S, H, D = q_shape
     bq, bk = default_blocks(S)
+    blk = max(bq, bk)
+    resident = (2 * S * D * itemsize      # two full-seq operands
+                + 2 * 8 * S * 4           # lse + delta, 8 sublanes fp32
+                + 2 * 4 * blk * D * 4)    # double-buffered fp32 blocks
     return (S % bq == 0 and S % bk == 0 and S >= bq
-            and D <= 256 and S * D * 4 <= (8 << 20))
+            and D <= 256 and resident <= (8 << 20))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -348,3 +359,6 @@ def _bwd_rule(scale, causal, block_q, block_k, interpret, residuals, g):
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)
+
+# Back-compat private name (tests and older callers).
+_dense_attention = dense_attention
