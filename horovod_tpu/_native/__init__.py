@@ -22,6 +22,16 @@ _lib: Optional[ctypes.CDLL] = None
 _lib_lock = threading.Lock()
 
 
+def job_secret(secret: Optional[str] = None) -> bytes:
+    """Resolve the control-plane HMAC secret († secret.py shared job
+    secret).  Explicit argument wins; otherwise ``HVDTPU_SECRET`` from the
+    environment (injected by the launcher); empty = unauthenticated
+    (single-user dev rigs)."""
+    if secret is None:
+        secret = os.environ.get("HVDTPU_SECRET", "")
+    return secret.encode()
+
+
 def load() -> ctypes.CDLL:
     global _lib
     with _lib_lock:
@@ -33,13 +43,13 @@ def load() -> ctypes.CDLL:
         lib = ctypes.CDLL(_SO_PATH)
         # KV store
         lib.hvd_kv_server_start.restype = ctypes.c_void_p
-        lib.hvd_kv_server_start.argtypes = [ctypes.c_int]
+        lib.hvd_kv_server_start.argtypes = [ctypes.c_int, ctypes.c_char_p]
         lib.hvd_kv_server_port.restype = ctypes.c_int
         lib.hvd_kv_server_port.argtypes = [ctypes.c_void_p]
         lib.hvd_kv_server_stop.argtypes = [ctypes.c_void_p]
         lib.hvd_kv_connect.restype = ctypes.c_void_p
         lib.hvd_kv_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
-                                       ctypes.c_int]
+                                       ctypes.c_int, ctypes.c_char_p]
         lib.hvd_kv_set.restype = ctypes.c_int
         lib.hvd_kv_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                    ctypes.c_char_p, ctypes.c_int]
@@ -53,13 +63,14 @@ def load() -> ctypes.CDLL:
         # Controller
         lib.hvd_ctrl_server_start.restype = ctypes.c_void_p
         lib.hvd_ctrl_server_start.argtypes = [ctypes.c_int, ctypes.c_int,
-                                              ctypes.c_int]
+                                              ctypes.c_int, ctypes.c_char_p]
         lib.hvd_ctrl_server_port.restype = ctypes.c_int
         lib.hvd_ctrl_server_port.argtypes = [ctypes.c_void_p]
         lib.hvd_ctrl_server_stop.argtypes = [ctypes.c_void_p]
         lib.hvd_ctrl_connect.restype = ctypes.c_void_p
         lib.hvd_ctrl_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
-                                         ctypes.c_int, ctypes.c_int]
+                                         ctypes.c_int, ctypes.c_int,
+                                         ctypes.c_char_p]
         lib.hvd_ctrl_negotiate.restype = ctypes.c_int
         lib.hvd_ctrl_negotiate.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                            ctypes.c_char_p, ctypes.c_int]
@@ -73,9 +84,10 @@ def load() -> ctypes.CDLL:
 class KvServer:
     """Rendezvous KV store server († Gloo ``RendezvousServer``)."""
 
-    def __init__(self, port: int = 0) -> None:
+    def __init__(self, port: int = 0,
+                 secret: Optional[str] = None) -> None:
         self._lib = load()
-        self._h = self._lib.hvd_kv_server_start(port)
+        self._h = self._lib.hvd_kv_server_start(port, job_secret(secret))
         if not self._h:
             raise OSError(f"failed to start KV server on port {port}")
 
@@ -98,9 +110,11 @@ class KvServer:
 class KvClient:
     """† ``gloo/http_store.cc`` client role."""
 
-    def __init__(self, host: str, port: int, timeout_ms: int = 10000) -> None:
+    def __init__(self, host: str, port: int, timeout_ms: int = 10000,
+                 secret: Optional[str] = None) -> None:
         self._lib = load()
-        self._h = self._lib.hvd_kv_connect(host.encode(), port, timeout_ms)
+        self._h = self._lib.hvd_kv_connect(host.encode(), port, timeout_ms,
+                                           job_secret(secret))
         if not self._h:
             raise ConnectionError(f"cannot reach KV server {host}:{port}")
 
@@ -112,6 +126,10 @@ class KvClient:
         buf = ctypes.create_string_buffer(1 << 16)
         n = self._lib.hvd_kv_wait(self._h, key.encode(), timeout_ms, buf,
                                   len(buf))
+        if n == -2:
+            raise ConnectionError(
+                "KV connection dropped — secret mismatch (HVDTPU_SECRET) "
+                "or server gone")
         if n < 0:
             raise TimeoutError(f"key {key!r} not set within {timeout_ms}ms")
         if n > len(buf):
@@ -140,9 +158,11 @@ class ControllerServer:
     """Rank-0 coordinator service († ``controller.cc``)."""
 
     def __init__(self, size: int, port: int = 0,
-                 stall_warn_ms: int = 60000) -> None:
+                 stall_warn_ms: int = 60000,
+                 secret: Optional[str] = None) -> None:
         self._lib = load()
-        self._h = self._lib.hvd_ctrl_server_start(port, size, stall_warn_ms)
+        self._h = self._lib.hvd_ctrl_server_start(port, size, stall_warn_ms,
+                                                  job_secret(secret))
         if not self._h:
             raise OSError(f"failed to start controller on port {port}")
 
@@ -166,10 +186,11 @@ class ControllerClient:
     """Per-rank negotiation client with the name→id response cache."""
 
     def __init__(self, host: str, port: int, rank: int,
-                 timeout_ms: int = 10000) -> None:
+                 timeout_ms: int = 10000,
+                 secret: Optional[str] = None) -> None:
         self._lib = load()
         self._h = self._lib.hvd_ctrl_connect(host.encode(), port, rank,
-                                             timeout_ms)
+                                             timeout_ms, job_secret(secret))
         if not self._h:
             raise ConnectionError(
                 f"cannot reach controller {host}:{port} (rank {rank})")

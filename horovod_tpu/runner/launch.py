@@ -143,8 +143,20 @@ def launch_workers(command: Sequence[str], *, np_total: int,
                        for _, h, _ in assignment)
     service_ip = "127.0.0.1" if is_local_job else my_ip
 
-    kv = KvServer()
-    ctrl = ControllerServer(size=np_total)
+    # Per-job shared secret authenticating every control-plane frame
+    # († secret.py: random HMAC secret per horovodrun invocation).  Reuse an
+    # inherited one so elastic re-launches keep the same credential.
+    import secrets as _secrets
+    job_secret = ((extra_env or {}).get("HVDTPU_SECRET")
+                  or os.environ.get("HVDTPU_SECRET")
+                  or _secrets.token_hex(16))
+    # Publish to this process so driver-side clients (elastic notification,
+    # re-launches) authenticate with the same credential.
+    os.environ.setdefault("HVDTPU_SECRET", job_secret)
+    job_secret = os.environ["HVDTPU_SECRET"]
+
+    kv = KvServer(secret=job_secret)
+    ctrl = ControllerServer(size=np_total, secret=job_secret)
     if is_local_job:
         coord_port = _free_port()
         coord_host = "127.0.0.1"
@@ -171,6 +183,7 @@ def launch_workers(command: Sequence[str], *, np_total: int,
             "HVDTPU_CONTROLLER_ADDR": f"{service_ip}:{ctrl.port}",
             "HVDTPU_RENDEZVOUS_ADDR": f"{service_ip}:{kv.port}",
             "HVDTPU_LOCAL_RANK": str(local_rank),
+            "HVDTPU_SECRET": job_secret,
         })
         return env
 
@@ -194,18 +207,27 @@ def launch_workers(command: Sequence[str], *, np_total: int,
             else:
                 # ssh fan-out: env goes on the remote command line since ssh
                 # doesn't forward arbitrary vars († gloo_run builds the same
-                # `ssh host env K=V ... cmd` line).
+                # `ssh host env K=V ... cmd` line) — EXCEPT the job secret,
+                # which would be world-readable in /proc/<pid>/cmdline on
+                # the remote host; it travels over ssh stdin instead.
                 env_kv = " ".join(
                     f"{k}={shlex.quote(v)}" for k, v in env.items()
-                    if k.startswith(("HVDTPU_", "HOROVOD_", "PATH",
-                                     "PYTHONPATH")))
-                remote = f"cd {shlex.quote(os.getcwd())} && env {env_kv} " \
-                    + " ".join(shlex.quote(c) for c in command)
+                    if k != "HVDTPU_SECRET"
+                    and k.startswith(("HVDTPU_", "HOROVOD_", "PATH",
+                                      "PYTHONPATH")))
+                remote = ("IFS= read -r HVDTPU_SECRET && "
+                          "export HVDTPU_SECRET && "
+                          f"cd {shlex.quote(os.getcwd())} && env {env_kv} "
+                          + " ".join(shlex.quote(c) for c in command))
                 proc = subprocess.Popen(
                     ["ssh", "-p", str(ssh_port),
                      "-o", "StrictHostKeyChecking=no", host, remote],
+                    stdin=subprocess.PIPE,
                     stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                     text=True, start_new_session=True)
+                assert proc.stdin is not None
+                proc.stdin.write(job_secret + "\n")
+                proc.stdin.close()
             worker = _Worker(rank, proc)
             workers.append(worker)
             threading.Thread(target=stream, args=(worker,),

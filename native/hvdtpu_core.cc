@@ -26,6 +26,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <random>
 #include <chrono>
 #include <condition_variable>
 #include <map>
@@ -39,6 +40,148 @@
 namespace {
 
 using Clock = std::chrono::steady_clock;
+
+// ---------------------------------------------------------------------------
+// SHA-256 + HMAC († runner/common/util/secret.py: the reference signs every
+// driver<->task RPC with a per-job random secret; here every control-plane
+// frame carries an HMAC-SHA256 tag when a secret is configured).  In-tree
+// implementation (FIPS 180-4 / RFC 2104) to avoid an OpenSSL dependency.
+// ---------------------------------------------------------------------------
+
+struct Sha256 {
+  uint32_t h[8];
+  uint8_t block[64];
+  uint64_t total = 0;
+  size_t fill = 0;
+
+  Sha256() {
+    static const uint32_t init[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                     0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                     0x1f83d9ab, 0x5be0cd19};
+    std::memcpy(h, init, sizeof(h));
+  }
+
+  static uint32_t rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+  }
+
+  void Compress(const uint8_t* p) {
+    static const uint32_t k[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+        0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+        0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+        0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+        0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+        0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+        0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+        0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+        0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+        0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+        0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; ++i) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+      uint32_t s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + s1 + ch + k[i] + w[i];
+      uint32_t s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = s0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void Update(const void* data, size_t len) {
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    total += len;
+    if (fill > 0) {
+      size_t take = std::min(len, 64 - fill);
+      std::memcpy(block + fill, p, take);
+      fill += take;
+      p += take;
+      len -= take;
+      if (fill == 64) {
+        Compress(block);
+        fill = 0;
+      }
+    }
+    while (len >= 64) {
+      Compress(p);
+      p += 64;
+      len -= 64;
+    }
+    if (len > 0) {
+      std::memcpy(block, p, len);
+      fill = len;
+    }
+  }
+
+  void Final(uint8_t out[32]) {
+    uint64_t bits = total * 8;
+    uint8_t pad = 0x80;
+    Update(&pad, 1);
+    uint8_t zero = 0;
+    while (fill != 56) Update(&zero, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; ++i) lenb[i] = uint8_t(bits >> (56 - 8 * i));
+    Update(lenb, 8);
+    for (int i = 0; i < 8; ++i) {
+      out[4 * i] = uint8_t(h[i] >> 24);
+      out[4 * i + 1] = uint8_t(h[i] >> 16);
+      out[4 * i + 2] = uint8_t(h[i] >> 8);
+      out[4 * i + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+constexpr size_t kTagLen = 32;
+
+void hmac_sha256(const std::string& key, const std::string& msg,
+                 uint8_t out[kTagLen]) {
+  uint8_t k[64] = {0};
+  if (key.size() > 64) {
+    Sha256 kh;
+    kh.Update(key.data(), key.size());
+    kh.Final(k);  // first 32 bytes; rest stay zero
+  } else {
+    std::memcpy(k, key.data(), key.size());
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; ++i) {
+    ipad[i] = k[i] ^ 0x36;
+    opad[i] = k[i] ^ 0x5c;
+  }
+  uint8_t inner[32];
+  Sha256 s1;
+  s1.Update(ipad, 64);
+  s1.Update(msg.data(), msg.size());
+  s1.Final(inner);
+  Sha256 s2;
+  s2.Update(opad, 64);
+  s2.Update(inner, 32);
+  s2.Final(out);
+}
+
+bool tags_equal(const uint8_t* a, const uint8_t* b) {
+  // Constant-time compare: no early exit on mismatch.
+  uint8_t diff = 0;
+  for (size_t i = 0; i < kTagLen; ++i) diff |= a[i] ^ b[i];
+  return diff == 0;
+}
 
 // ---------------------------------------------------------------------------
 // socket helpers
@@ -86,6 +229,92 @@ bool recv_frame(int fd, std::string* out) {
   if (len > (64u << 20)) return false;  // sanity cap: 64 MB control frames
   out->resize(len);
   return len == 0 || recv_all(fd, &(*out)[0], len);
+}
+
+// Authenticated framing.  Per connection the server picks a random nonce
+// (sent in the clear on accept); every subsequent frame's payload is
+// tag(32) || body with tag = HMAC-SHA256(secret, nonce || dir || seq ||
+// body).  The nonce kills cross-connection replay, the direction byte
+// ('C' client->server, 'S' server->client) kills reflection, and the
+// per-direction monotonic sequence kills in-connection replay/reorder.  A
+// frame that fails verification is a transport error: the connection is
+// dropped, the same containment the reference applies to bad-signature
+// RPCs.
+constexpr size_t kNonceLen = 16;
+
+std::string random_nonce() {
+  std::string n(kNonceLen, '\0');
+  std::random_device rd;
+  for (auto& c : n) c = static_cast<char>(rd());
+  return n;
+}
+
+struct AuthChannel {
+  std::string secret;
+  std::string nonce;
+  char send_dir = 'C';
+  char recv_dir = 'S';
+  uint64_t send_seq = 0;
+  uint64_t recv_seq = 0;
+
+  std::string MacInput(char dir, uint64_t seq, const std::string& body) const {
+    std::string m = nonce;
+    m += dir;
+    for (int i = 7; i >= 0; --i) m += static_cast<char>(seq >> (8 * i));
+    m += body;
+    return m;
+  }
+};
+
+// Server side of the handshake: send the per-connection nonce.
+bool auth_accept(int fd, AuthChannel* ch, const std::string& secret) {
+  ch->secret = secret;
+  ch->send_dir = 'S';
+  ch->recv_dir = 'C';
+  if (secret.empty()) return true;
+  ch->nonce = random_nonce();
+  return send_frame(fd, ch->nonce);
+}
+
+// Client side: receive the nonce.  Bounded by a receive timeout so a
+// client pointed at an unauthenticated server fails fast instead of
+// blocking forever on a nonce that will never come.
+bool auth_connect(int fd, AuthChannel* ch, const std::string& secret) {
+  ch->secret = secret;
+  ch->send_dir = 'C';
+  ch->recv_dir = 'S';
+  if (secret.empty()) return true;
+  timeval tv{10, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  bool ok = recv_frame(fd, &ch->nonce) && ch->nonce.size() == kNonceLen;
+  timeval off{0, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &off, sizeof(off));
+  return ok;
+}
+
+bool send_auth_frame(int fd, AuthChannel* ch, const std::string& body) {
+  if (ch->secret.empty()) return send_frame(fd, body);
+  std::string payload;
+  payload.resize(kTagLen);
+  hmac_sha256(ch->secret, ch->MacInput(ch->send_dir, ch->send_seq, body),
+              reinterpret_cast<uint8_t*>(&payload[0]));
+  ch->send_seq++;
+  payload += body;
+  return send_frame(fd, payload);
+}
+
+bool recv_auth_frame(int fd, AuthChannel* ch, std::string* body) {
+  if (ch->secret.empty()) return recv_frame(fd, body);
+  std::string payload;
+  if (!recv_frame(fd, &payload) || payload.size() < kTagLen) return false;
+  std::string b = payload.substr(kTagLen);
+  uint8_t want[kTagLen];
+  hmac_sha256(ch->secret, ch->MacInput(ch->recv_dir, ch->recv_seq, b), want);
+  if (!tags_equal(want, reinterpret_cast<const uint8_t*>(payload.data())))
+    return false;
+  ch->recv_seq++;
+  *body = std::move(b);
+  return true;
 }
 
 int listen_on(int port) {
@@ -171,7 +400,7 @@ std::string get_str(const std::string& s, size_t* off) {
 
 class KvServer {
  public:
-  explicit KvServer(int port) {
+  KvServer(int port, std::string secret) : secret_(std::move(secret)) {
     listen_fd_ = listen_on(port);
     if (listen_fd_ >= 0) {
       port_ = bound_port(listen_fd_);
@@ -212,8 +441,13 @@ class KvServer {
   }
 
   void ClientLoop(int fd) {
+    AuthChannel ch;
+    if (!auth_accept(fd, &ch, secret_)) {
+      ::close(fd);
+      return;
+    }
     std::string frame;
-    while (!stopping_ && recv_frame(fd, &frame)) {
+    while (!stopping_ && recv_auth_frame(fd, &ch, &frame)) {
       if (frame.empty()) continue;
       char op = frame[0];
       size_t off = 1;
@@ -225,7 +459,7 @@ class KvServer {
           table_[key] = val;
         }
         cv_.notify_all();
-        send_frame(fd, "K");
+        send_auth_frame(fd, &ch, "K");
       } else if (op == 'W' || op == 'G') {
         std::string key = get_str(frame, &off);
         uint32_t timeout_ms = (op == 'W') ? get_u32(frame, &off) : 0;
@@ -237,17 +471,17 @@ class KvServer {
         auto it = table_.find(key);
         if (it == table_.end()) {
           lk.unlock();
-          send_frame(fd, "M");  // missing
+          send_auth_frame(fd, &ch, "M");  // missing
         } else {
           std::string reply = "V" + it->second;
           lk.unlock();
-          send_frame(fd, reply);
+          send_auth_frame(fd, &ch, reply);
         }
       } else if (op == 'D') {  // delete (elastic re-rendezvous reuse)
         std::string key = get_str(frame, &off);
         std::lock_guard<std::mutex> g(mu_);
         table_.erase(key);
-        send_frame(fd, "K");
+        send_auth_frame(fd, &ch, "K");
       }
     }
     ::close(fd);
@@ -255,6 +489,7 @@ class KvServer {
 
   int listen_fd_ = -1;
   int port_ = -1;
+  std::string secret_;
   std::atomic<bool> stopping_{false};
   std::thread accept_thread_;
   std::vector<std::thread> client_threads_;
@@ -266,8 +501,12 @@ class KvServer {
 
 class KvClient {
  public:
-  KvClient(const char* host, int port, int timeout_ms) {
+  KvClient(const char* host, int port, int timeout_ms, std::string secret) {
     fd_ = connect_to(host, port, timeout_ms);
+    if (fd_ >= 0 && !auth_connect(fd_, &ch_, secret)) {
+      ::close(fd_);
+      fd_ = -1;
+    }
   }
   ~KvClient() {
     if (fd_ >= 0) ::close(fd_);
@@ -280,20 +519,24 @@ class KvClient {
     put_str(&msg, key);
     msg += val;
     std::string reply;
-    return send_frame(fd_, msg) && recv_frame(fd_, &reply) && reply == "K";
+    return send_auth_frame(fd_, &ch_, msg) &&
+           recv_auth_frame(fd_, &ch_, &reply) && reply == "K";
   }
 
-  // returns true + val, or false if absent within timeout.
-  bool Wait(const std::string& key, int timeout_ms, std::string* val) {
+  // 1 = got value, 0 = absent within timeout, -1 = transport/auth failure
+  // (connection dropped — e.g. the server rejected our MAC).
+  int Wait(const std::string& key, int timeout_ms, std::string* val) {
     std::lock_guard<std::mutex> g(mu_);
     std::string msg = "W";
     put_str(&msg, key);
     put_u32(&msg, static_cast<uint32_t>(timeout_ms));
     std::string reply;
-    if (!send_frame(fd_, msg) || !recv_frame(fd_, &reply)) return false;
-    if (reply.empty() || reply[0] != 'V') return false;
+    if (!send_auth_frame(fd_, &ch_, msg) ||
+        !recv_auth_frame(fd_, &ch_, &reply))
+      return -1;
+    if (reply.empty() || reply[0] != 'V') return 0;
     *val = reply.substr(1);
-    return true;
+    return 1;
   }
 
   bool Del(const std::string& key) {
@@ -301,11 +544,13 @@ class KvClient {
     std::string msg = "D";
     put_str(&msg, key);
     std::string reply;
-    return send_frame(fd_, msg) && recv_frame(fd_, &reply) && reply == "K";
+    return send_auth_frame(fd_, &ch_, msg) &&
+           recv_auth_frame(fd_, &ch_, &reply) && reply == "K";
   }
 
  private:
   int fd_ = -1;
+  AuthChannel ch_;
   std::mutex mu_;
 };
 
@@ -340,8 +585,9 @@ struct TensorState {
 
 class Controller {
  public:
-  Controller(int port, int size, int stall_warn_ms)
-      : size_(static_cast<uint32_t>(size)), stall_warn_ms_(stall_warn_ms) {
+  Controller(int port, int size, int stall_warn_ms, std::string secret)
+      : size_(static_cast<uint32_t>(size)), stall_warn_ms_(stall_warn_ms),
+        secret_(std::move(secret)) {
     listen_fd_ = listen_on(port);
     if (listen_fd_ >= 0) {
       port_ = bound_port(listen_fd_);
@@ -386,9 +632,14 @@ class Controller {
   // One thread per rank connection; implements the barrier-per-round
   // semantics of † MPIController (gather at rank 0, bcast response).
   void RankLoop(int fd) {
+    AuthChannel ch;
+    if (!auth_accept(fd, &ch, secret_)) {
+      ::close(fd);
+      return;
+    }
     uint32_t my_rank = UINT32_MAX;
     std::string frame;
-    while (!stopping_ && recv_frame(fd, &frame)) {
+    while (!stopping_ && recv_auth_frame(fd, &ch, &frame)) {
       size_t off = 0;
       uint32_t rank = get_u32(frame, &off);
       uint32_t n = get_u32(frame, &off);
@@ -427,7 +678,7 @@ class Controller {
       if (stopping_) break;
       std::string reply = last_response_;
       lk.unlock();
-      send_frame(fd, reply);
+      send_auth_frame(fd, &ch, reply);
     }
     ::close(fd);
   }
@@ -503,6 +754,7 @@ class Controller {
 
   uint32_t size_;
   int stall_warn_ms_;
+  std::string secret_;
   int listen_fd_ = -1;
   int port_ = -1;
   std::atomic<bool> stopping_{false};
@@ -525,9 +777,14 @@ class Controller {
 // client half: steady state sends ids, not names).
 class CtrlClient {
  public:
-  CtrlClient(const char* host, int port, int rank, int timeout_ms)
+  CtrlClient(const char* host, int port, int rank, int timeout_ms,
+             std::string secret)
       : rank_(static_cast<uint32_t>(rank)) {
     fd_ = connect_to(host, port, timeout_ms);
+    if (fd_ >= 0 && !auth_connect(fd_, &ch_, secret)) {
+      ::close(fd_);
+      fd_ = -1;
+    }
   }
   ~CtrlClient() {
     if (fd_ >= 0) ::close(fd_);
@@ -554,7 +811,9 @@ class CtrlClient {
       }
     }
     std::string reply;
-    if (!send_frame(fd_, msg) || !recv_frame(fd_, &reply)) return false;
+    if (!send_auth_frame(fd_, &ch_, msg) ||
+        !recv_auth_frame(fd_, &ch_, &reply))
+      return false;
     size_t off = 0;
     uint32_t n_ready = get_u32(reply, &off);
     ready->clear();
@@ -577,6 +836,7 @@ class CtrlClient {
  private:
   int fd_ = -1;
   uint32_t rank_;
+  AuthChannel ch_;
   std::unordered_map<std::string, uint32_t> cache_;
 };
 
@@ -589,8 +849,8 @@ class CtrlClient {
 extern "C" {
 
 // -- KV store --
-void* hvd_kv_server_start(int port) {
-  auto* s = new KvServer(port);
+void* hvd_kv_server_start(int port, const char* secret) {
+  auto* s = new KvServer(port, secret ? secret : "");
   if (!s->ok()) {
     delete s;
     return nullptr;
@@ -600,8 +860,9 @@ void* hvd_kv_server_start(int port) {
 int hvd_kv_server_port(void* s) { return static_cast<KvServer*>(s)->port(); }
 void hvd_kv_server_stop(void* s) { delete static_cast<KvServer*>(s); }
 
-void* hvd_kv_connect(const char* host, int port, int timeout_ms) {
-  auto* c = new KvClient(host, port, timeout_ms);
+void* hvd_kv_connect(const char* host, int port, int timeout_ms,
+                     const char* secret) {
+  auto* c = new KvClient(host, port, timeout_ms, secret ? secret : "");
   if (!c->ok()) {
     delete c;
     return nullptr;
@@ -615,12 +876,15 @@ int hvd_kv_set(void* c, const char* key, const uint8_t* val, int len) {
              ? 0
              : -1;
 }
-// Returns value length (may exceed cap, caller re-calls with bigger buf), or
-// -1 if absent/timeout.
+// Returns value length (may exceed cap, caller re-calls with bigger buf),
+// -1 if absent/timeout, -2 on transport/auth failure (connection dropped,
+// e.g. MAC rejected).
 int hvd_kv_wait(void* c, const char* key, int timeout_ms, uint8_t* buf,
                 int cap) {
   std::string val;
-  if (!static_cast<KvClient*>(c)->Wait(key, timeout_ms, &val)) return -1;
+  int rc = static_cast<KvClient*>(c)->Wait(key, timeout_ms, &val);
+  if (rc < 0) return -2;
+  if (rc == 0) return -1;
   int n = static_cast<int>(val.size());
   if (buf != nullptr && cap >= n) std::memcpy(buf, val.data(), val.size());
   return n;
@@ -631,8 +895,9 @@ int hvd_kv_del(void* c, const char* key) {
 void hvd_kv_close(void* c) { delete static_cast<KvClient*>(c); }
 
 // -- Controller --
-void* hvd_ctrl_server_start(int port, int size, int stall_warn_ms) {
-  auto* s = new Controller(port, size, stall_warn_ms);
+void* hvd_ctrl_server_start(int port, int size, int stall_warn_ms,
+                            const char* secret) {
+  auto* s = new Controller(port, size, stall_warn_ms, secret ? secret : "");
   if (!s->ok()) {
     delete s;
     return nullptr;
@@ -644,8 +909,10 @@ int hvd_ctrl_server_port(void* s) {
 }
 void hvd_ctrl_server_stop(void* s) { delete static_cast<Controller*>(s); }
 
-void* hvd_ctrl_connect(const char* host, int port, int rank, int timeout_ms) {
-  auto* c = new CtrlClient(host, port, rank, timeout_ms);
+void* hvd_ctrl_connect(const char* host, int port, int rank, int timeout_ms,
+                       const char* secret) {
+  auto* c = new CtrlClient(host, port, rank, timeout_ms,
+                           secret ? secret : "");
   if (!c->ok()) {
     delete c;
     return nullptr;

@@ -238,3 +238,147 @@ def test_negotiate_multiprocess():
             outs.append(stdout.strip())
         assert len(set(outs)) == 1
         assert outs[0] == "grad.0,grad.1,grad.2"
+
+
+# ---------------------------------------------------------------------------
+# HMAC-authenticated control plane († runner/common/util/secret.py: per-job
+# shared secret signs every driver<->task RPC)
+# ---------------------------------------------------------------------------
+
+def test_kv_auth_roundtrip():
+    with KvServer(secret="s3cr3t") as srv:
+        c = KvClient("127.0.0.1", srv.port, secret="s3cr3t")
+        c.set("k", b"v")
+        assert c.wait("k") == b"v"
+        c.close()
+
+
+def test_kv_auth_wrong_secret_rejected():
+    with KvServer(secret="right") as srv:
+        c = KvClient("127.0.0.1", srv.port, secret="wrong")
+        with pytest.raises(OSError):
+            c.set("k", b"v")
+        c.close()
+        # The server must still serve properly-authed clients afterwards.
+        good = KvClient("127.0.0.1", srv.port, secret="right")
+        good.set("k", b"v2")
+        assert good.wait("k") == b"v2"
+        good.close()
+
+
+def test_kv_auth_unauthenticated_client_rejected():
+    with KvServer(secret="right") as srv:
+        c = KvClient("127.0.0.1", srv.port, secret="")
+        with pytest.raises(OSError):
+            c.set("k", b"v")
+        c.close()
+
+
+def test_kv_secret_from_env(monkeypatch):
+    monkeypatch.setenv("HVDTPU_SECRET", "env-secret")
+    with KvServer() as srv:                      # picks up env
+        c = KvClient("127.0.0.1", srv.port)      # picks up env
+        c.set("k", b"v")
+        assert c.wait("k") == b"v"
+        c.close()
+
+
+def test_ctrl_auth_negotiation():
+    with ControllerServer(size=2, secret="job") as srv:
+        results = {}
+
+        def rank_fn(r):
+            c = ControllerClient("127.0.0.1", srv.port, r, secret="job")
+            ready, _ = c.negotiate(["t0"])
+            results[r] = ready
+            c.close()
+
+        ts = [threading.Thread(target=rank_fn, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert results[0] == results[1] == ["t0"]
+
+
+def test_ctrl_auth_wrong_secret_fails():
+    with ControllerServer(size=1, secret="job") as srv:
+        c = ControllerClient("127.0.0.1", srv.port, 0, secret="nope")
+        with pytest.raises(ConnectionError):
+            c.negotiate(["t0"])
+        c.close()
+
+
+def _recvn(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return buf
+        buf += chunk
+    return buf
+
+
+def _mac_frame(secret, nonce, direction, seq, body):
+    """Mirror of the native wire format: tag = HMAC(secret,
+    nonce || dir || seq_be64 || body); frame = u32 len || tag || body."""
+    import hashlib
+    import hmac as pyhmac
+    import struct
+    m = nonce + direction + struct.pack(">Q", seq) + body
+    tag = pyhmac.new(secret, m, hashlib.sha256).digest()
+    payload = tag + body
+    return struct.pack(">I", len(payload)) + payload
+
+
+def _recv_auth_reply(sock, secret, nonce, seq):
+    import hashlib
+    import hmac as pyhmac
+    import struct
+    hdr = _recvn(sock, 4)
+    if len(hdr) < 4:
+        return None  # connection closed
+    ln = struct.unpack(">I", hdr)[0]
+    payload = _recvn(sock, ln)
+    tag, body = payload[:32], payload[32:]
+    m = nonce + b"S" + struct.pack(">Q", seq) + body
+    assert pyhmac.new(secret, m, hashlib.sha256).digest() == tag
+    return body
+
+
+def test_kv_auth_replay_and_reflection_rejected():
+    import socket
+    import struct
+    with KvServer(secret="job") as srv:
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        nonce = _recvn(s, struct.unpack(">I", _recvn(s, 4))[0])
+        assert len(nonce) == 16
+        body = b"S" + struct.pack(">I", 1) + b"k" + b"v"
+        frame0 = _mac_frame(b"job", nonce, b"C", 0, body)
+        s.sendall(frame0)
+        assert _recv_auth_reply(s, b"job", nonce, 0) == b"K"
+        # In-connection replay: same frame again (stale seq) -> dropped.
+        s.sendall(frame0)
+        assert _recv_auth_reply(s, b"job", nonce, 1) in (None, b"")
+        s.close()
+
+        # Cross-connection replay: frame MAC'd under the old nonce -> dropped.
+        s2 = socket.create_connection(("127.0.0.1", srv.port))
+        nonce2 = _recvn(s2, struct.unpack(">I", _recvn(s2, 4))[0])
+        assert nonce2 != nonce
+        s2.sendall(frame0)
+        assert _recv_auth_reply(s2, b"job", nonce2, 0) in (None, b"")
+        s2.close()
+
+        # Reflection: a server-direction frame sent as a client frame.
+        s3 = socket.create_connection(("127.0.0.1", srv.port))
+        nonce3 = _recvn(s3, struct.unpack(">I", _recvn(s3, 4))[0])
+        reflected = _mac_frame(b"job", nonce3, b"S", 0, body)
+        s3.sendall(reflected)
+        assert _recv_auth_reply(s3, b"job", nonce3, 0) in (None, b"")
+        s3.close()
+
+        # Honest clients still work after all that.
+        good = KvClient("127.0.0.1", srv.port, secret="job")
+        assert good.wait("k") == b"v"
+        good.close()
