@@ -135,6 +135,10 @@ class KvClient:
         if n > len(buf):
             buf = ctypes.create_string_buffer(n)
             n = self._lib.hvd_kv_wait(self._h, key.encode(), 0, buf, n)
+            if n == -2:
+                raise ConnectionError(
+                    "KV connection dropped — secret mismatch "
+                    "(HVDTPU_SECRET) or server gone")
             if n < 0:
                 raise TimeoutError(f"key {key!r} disappeared")
         return buf.raw[:n]

@@ -1,0 +1,124 @@
+"""Data stores for the estimator layer.
+
+† ``horovod/spark/common/store.py``: the reference's estimators read
+training data through a ``Store`` (HDFS/S3/local) that stages intermediate
+parquet files and run artifacts (checkpoints, logs).  Here the same role is
+covered without Spark (not in the image, and on TPU the deployment unit is
+a VM slice, not an executor): a :class:`LocalStore` keeps run artifacts,
+and :func:`to_columns` ingests the formats users actually hand us —
+pandas DataFrames, column dicts, structured numpy arrays, or parquet
+files/directories (the Petastorm role, via pyarrow).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+
+class LocalStore:
+    """Run-artifact store rooted at a local (or NFS/GCS-fuse) directory.
+
+    Layout: ``<prefix>/runs/<run_id>/checkpoints`` and ``.../logs`` —
+    mirroring † ``Store.get_checkpoint_path`` / ``get_logs_path``.
+    """
+
+    def __init__(self, prefix: str) -> None:
+        self.prefix = os.path.abspath(prefix)
+
+    def run_path(self, run_id: str) -> str:
+        return os.path.join(self.prefix, "runs", run_id)
+
+    def checkpoint_path(self, run_id: str) -> str:
+        path = os.path.join(self.run_path(run_id), "checkpoints")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def logs_path(self, run_id: str) -> str:
+        path = os.path.join(self.run_path(run_id), "logs")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+
+def _read_parquet(path: str) -> dict[str, np.ndarray]:
+    import pyarrow.parquet as pq
+    files = sorted(glob.glob(os.path.join(path, "*.parquet"))) \
+        if os.path.isdir(path) else [path]
+    if not files:
+        raise FileNotFoundError(f"no parquet files under {path}")
+    tables = [pq.read_table(f) for f in files]
+    import pyarrow as pa
+    table = pa.concat_tables(tables)
+    out = {}
+    for name in table.column_names:
+        col = table.column(name).combine_chunks()
+        if pa.types.is_list(col.type) or pa.types.is_fixed_size_list(
+                col.type):
+            # Column of vectors -> 2-D array without Python boxing.
+            flat = col.flatten().to_numpy(zero_copy_only=False)
+            out[name] = flat.reshape(len(col), -1)
+        else:
+            out[name] = col.to_numpy(zero_copy_only=False)
+    return out
+
+
+def to_columns(data: Any,
+               columns: Optional[Sequence[str]] = None
+               ) -> dict[str, np.ndarray]:
+    """Normalize ``data`` to ``{column: np.ndarray}`` with equal row counts.
+
+    Accepts a pandas DataFrame, a dict of array-likes, a structured numpy
+    array, or a path to a parquet file/directory.
+    """
+    if isinstance(data, str):
+        cols = _read_parquet(data)
+    elif isinstance(data, dict):
+        cols = {k: np.asarray(v) for k, v in data.items()}
+    elif isinstance(data, np.ndarray) and data.dtype.names:
+        cols = {n: np.asarray(data[n]) for n in data.dtype.names}
+    else:
+        try:
+            import pandas as pd
+        except ImportError:  # pragma: no cover
+            pd = None
+        if pd is not None and isinstance(data, pd.DataFrame):
+            cols = {}
+            for name in data.columns:
+                series = data[name]
+                if series.dtype == object:
+                    # Column of fixed-size vectors (the Spark ML "features"
+                    # column shape) -> 2-D array.
+                    cols[name] = np.stack(
+                        [np.asarray(v) for v in series.to_numpy()])
+                else:
+                    cols[name] = series.to_numpy()
+        else:
+            raise TypeError(
+                f"unsupported data type {type(data).__name__}: expected "
+                "DataFrame, dict of arrays, structured array, or parquet "
+                "path")
+    if columns is not None:
+        missing = [c for c in columns if c not in cols]
+        if missing:
+            raise KeyError(f"columns {missing} not in data "
+                           f"(have {sorted(cols)})")
+        cols = {c: cols[c] for c in columns}
+    sizes = {k: len(v) for k, v in cols.items()}
+    if len(set(sizes.values())) > 1:
+        raise ValueError(f"ragged columns: {sizes}")
+    return cols
+
+
+def train_val_split(cols: dict[str, np.ndarray], validation: float,
+                    seed: int) -> tuple[dict, dict]:
+    """Row-wise split († estimator ``validation`` param: fraction)."""
+    n = len(next(iter(cols.values())))
+    rng = np.random.RandomState(seed)
+    perm = rng.permutation(n)
+    n_val = int(n * validation)
+    val_idx, train_idx = perm[:n_val], perm[n_val:]
+    take = lambda idx: {k: v[idx] for k, v in cols.items()}
+    return take(train_idx), take(val_idx)

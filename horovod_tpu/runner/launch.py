@@ -151,9 +151,9 @@ def launch_workers(command: Sequence[str], *, np_total: int,
                   or os.environ.get("HVDTPU_SECRET")
                   or _secrets.token_hex(16))
     # Publish to this process so driver-side clients (elastic notification,
-    # re-launches) authenticate with the same credential.
-    os.environ.setdefault("HVDTPU_SECRET", job_secret)
-    job_secret = os.environ["HVDTPU_SECRET"]
+    # re-launches) authenticate with the same credential; assignment (not
+    # setdefault) so an explicitly passed secret wins over a stale one.
+    os.environ["HVDTPU_SECRET"] = job_secret
 
     kv = KvServer(secret=job_secret)
     ctrl = ControllerServer(size=np_total, secret=job_secret)
@@ -225,9 +225,13 @@ def launch_workers(command: Sequence[str], *, np_total: int,
                     stdin=subprocess.PIPE,
                     stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                     text=True, start_new_session=True)
-                assert proc.stdin is not None
-                proc.stdin.write(job_secret + "\n")
-                proc.stdin.close()
+                try:
+                    assert proc.stdin is not None
+                    proc.stdin.write(job_secret + "\n")
+                    proc.stdin.close()
+                except (BrokenPipeError, OSError):
+                    pass  # ssh died instantly; the monitor reports it
+
             worker = _Worker(rank, proc)
             workers.append(worker)
             threading.Thread(target=stream, args=(worker,),

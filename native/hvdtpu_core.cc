@@ -226,7 +226,10 @@ bool recv_frame(int fd, std::string* out) {
   uint32_t len_n;
   if (!recv_all(fd, &len_n, 4)) return false;
   uint32_t len = ntohl(len_n);
-  if (len > (64u << 20)) return false;  // sanity cap: 64 MB control frames
+  // Pre-auth allocation bound: control-plane payloads are tiny (names,
+  // addresses, pickled membership state); reject oversized frames before
+  // allocating so unauthenticated peers can't balloon the coordinator.
+  if (len > (8u << 20)) return false;
   out->resize(len);
   return len == 0 || recv_all(fd, &(*out)[0], len);
 }

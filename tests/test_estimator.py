@@ -1,0 +1,173 @@
+"""Estimator API († horovod.spark KerasEstimator/TorchEstimator role):
+fit/predict/transform from DataFrames, dicts, and parquet, with the mesh
+as the data plane.
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.estimator import (
+    JaxEstimator,
+    KerasEstimator,
+    LocalStore,
+    to_columns,
+)
+from horovod_tpu.estimator.store import train_val_split
+
+
+def _regression_frame(n=256, seed=0):
+    import pandas as pd
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    w = np.asarray([1.0, -2.0, 0.5, 3.0], np.float32)
+    y = x @ w + 0.01 * rng.randn(n).astype(np.float32)
+    return pd.DataFrame({"features": list(x), "label": y})
+
+
+# ---------------------------------------------------------------------------
+# data ingestion
+# ---------------------------------------------------------------------------
+
+def test_to_columns_from_dataframe_and_dict():
+    df = _regression_frame(32)
+    cols = to_columns(df)
+    assert cols["features"].shape == (32, 4)
+    assert cols["label"].shape == (32,)
+    cols2 = to_columns({"a": [1, 2], "b": [3.0, 4.0]})
+    assert cols2["a"].tolist() == [1, 2]
+
+
+def test_to_columns_parquet_roundtrip(tmp_path):
+    import pandas as pd
+    df = pd.DataFrame({"x": np.arange(10.0), "y": np.arange(10) % 2})
+    path = str(tmp_path / "part-0.parquet")
+    df.to_parquet(path)
+    cols = to_columns(str(tmp_path))
+    assert cols["x"].shape == (10,)
+    np.testing.assert_allclose(cols["x"], np.arange(10.0))
+
+
+def test_to_columns_validation_errors():
+    with pytest.raises(ValueError):
+        to_columns({"a": [1, 2], "b": [1, 2, 3]})
+    with pytest.raises(KeyError):
+        to_columns({"a": [1]}, columns=["missing"])
+    with pytest.raises(TypeError):
+        to_columns(42)
+
+
+def test_train_val_split_partitions_rows():
+    cols = {"x": np.arange(100), "y": np.arange(100) * 2}
+    tr, va = train_val_split(cols, 0.25, seed=0)
+    assert len(va["x"]) == 25 and len(tr["x"]) == 75
+    assert sorted(np.concatenate([tr["x"], va["x"]]).tolist()) == \
+        list(range(100))
+
+
+# ---------------------------------------------------------------------------
+# JaxEstimator
+# ---------------------------------------------------------------------------
+
+class _Linear:
+    """Minimal flax-API model (init/apply) to keep the test light."""
+
+    def init(self, rng, x):
+        import jax
+        return {"w": jax.random.normal(rng, (x.shape[-1],)) * 0.1,
+                "b": jax.numpy.zeros(())}
+
+    def apply(self, params, x):
+        return x @ params["w"] + params["b"]
+
+
+def test_jax_estimator_learns_regression():
+    df = _regression_frame()
+    import optax
+    est = JaxEstimator(model=_Linear(), feature_cols=["features"],
+                       label_cols=["label"], loss="mse", batch_size=64,
+                       epochs=30, seed=0, optimizer=optax.adam(0.1))
+    fitted = est.fit(df)
+    assert fitted.history[-1]["loss"] < fitted.history[0]["loss"]
+    preds = fitted.predict(df)
+    target = to_columns(df)["label"]
+    mse = float(np.mean((preds - target) ** 2))
+    assert mse < 0.5, mse
+    out = fitted.transform(df)
+    assert "prediction" in out.columns
+
+
+def test_jax_estimator_flax_module_classification():
+    import flax.linen as nn
+    import pandas as pd
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(3)(x)
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(240, 5).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64) + (x[:, 1] > 0)
+    df = pd.DataFrame({"features": list(x), "label": y})
+    import optax
+    est = JaxEstimator(model=MLP(), feature_cols=["features"],
+                       label_cols=["label"], loss="xent", batch_size=48,
+                       epochs=25, validation=0.2, seed=1,
+                       optimizer=optax.adam(0.01))
+    fitted = est.fit(df)
+    assert "val_loss" in fitted.history[-1]
+    acc = float(np.mean(
+        fitted.predict(df).argmax(-1) == y))
+    assert acc > 0.7, acc
+
+
+def test_jax_estimator_checkpoints_to_store(tmp_path):
+    store = LocalStore(str(tmp_path))
+    df = _regression_frame(64)
+    est = JaxEstimator(model=_Linear(), feature_cols=["features"],
+                       label_cols=["label"], batch_size=32, epochs=2,
+                       store=store, run_id="run1")
+    est.fit(df)
+    from horovod_tpu.utils.checkpoint import Checkpointer
+    ckpt = Checkpointer(store.checkpoint_path("run1"))
+    assert ckpt.latest_step() == 1
+    restored = ckpt.restore()
+    assert "params" in restored
+
+
+def test_jax_estimator_rejects_tiny_data():
+    df = _regression_frame(4)
+    est = JaxEstimator(model=_Linear(), feature_cols=["features"],
+                       label_cols=["label"], batch_size=64)
+    with pytest.raises(ValueError, match="rows"):
+        est.fit(df)
+
+
+# ---------------------------------------------------------------------------
+# KerasEstimator (single-process path; the callback rig is exercised by
+# test_bindings.py's multi-rank keras tests)
+# ---------------------------------------------------------------------------
+
+def test_keras_estimator_fit_predict(tmp_path):
+    keras = pytest.importorskip("keras")
+    model = keras.Sequential([
+        keras.layers.Input(shape=(4,)),
+        keras.layers.Dense(1),
+    ])
+    model.compile(optimizer=keras.optimizers.Adam(0.05), loss="mse")
+    df = _regression_frame(128)
+    est = KerasEstimator(model=model, feature_cols=["features"],
+                         label_cols=["label"], batch_size=32, epochs=8,
+                         validation=0.25,
+                         store=LocalStore(str(tmp_path)), run_id="k1")
+    fitted = est.fit(df)
+    assert fitted.history and "val_loss" in fitted.history
+    preds = fitted.predict(df)
+    assert preds.shape[0] == 128
+    out = fitted.transform(df)
+    assert "prediction" in out.columns
+    import os
+    assert os.path.exists(
+        os.path.join(str(tmp_path), "runs", "k1", "checkpoints",
+                     "model.keras"))
