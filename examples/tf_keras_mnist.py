@@ -1,0 +1,59 @@
+"""TF/Keras MNIST with horovod_tpu — the reference's
+``examples/tensorflow2/tensorflow2_keras_mnist.py`` workflow, TPU-native
+runtime underneath.
+
+Run single-host:    python examples/tf_keras_mnist.py
+Run multi-process:  hvdrun -np 2 python examples/tf_keras_mnist.py
+"""
+
+import numpy as np
+
+import horovod_tpu.tensorflow.keras as hvd
+
+
+def main() -> None:
+    import keras
+
+    hvd.init()
+
+    # Synthetic MNIST-shaped data (the image has no dataset downloads).
+    rng = np.random.RandomState(hvd.rank())
+    x = rng.rand(512, 28, 28, 1).astype("float32")
+    y = rng.randint(0, 10, size=(512,))
+
+    keras.utils.set_random_seed(42)  # same init everywhere; broadcast confirms
+    model = keras.Sequential([
+        keras.layers.Input((28, 28, 1)),
+        keras.layers.Conv2D(16, 3, activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Flatten(),
+        keras.layers.Dense(64, activation="relu"),
+        keras.layers.Dense(10),
+    ])
+
+    # † scale lr by size; wrap optimizer; broadcast at train begin;
+    # average metrics; checkpoint on rank 0 only.
+    scaled_lr = 0.001 * hvd.size()
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.Adam(learning_rate=scaled_lr))
+    model.compile(
+        optimizer=opt,
+        loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"])
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(
+            initial_lr=scaled_lr, warmup_epochs=1, steps_per_epoch=8),
+    ]
+    model.fit(x, y, batch_size=64, epochs=2, verbose=2 if hvd.rank() == 0 else 0,
+              callbacks=callbacks)
+
+    if hvd.rank() == 0:
+        model.save("/tmp/hvdtpu_tf_mnist.keras")
+        print("rank 0 saved checkpoint")
+
+
+if __name__ == "__main__":
+    main()

@@ -409,6 +409,100 @@ def join(rank_done: Optional[int] = None) -> int:
     return size() - 1
 
 
+# ---------------------------------------------------------------------------
+# Runtime timeline control († hvd.start_timeline / stop_timeline, v0.21)
+# ---------------------------------------------------------------------------
+
+def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
+    """Begin writing the Chrome-trace timeline at runtime
+    († ``hvd.start_timeline``).  Replaces any active timeline."""
+    from .utils.timeline import Timeline
+    state = global_state()
+    if not state.initialized:
+        raise NotInitializedError()
+    old = state.timeline
+    state.timeline = Timeline(file_path, mark_cycles=mark_cycles)
+    if old is not None:
+        old.close()
+
+
+def stop_timeline() -> None:
+    """Stop and flush the active timeline († ``hvd.stop_timeline``)."""
+    state = global_state()
+    if not state.initialized:
+        raise NotInitializedError()
+    old, state.timeline = state.timeline, None
+    if old is not None:
+        old.close()
+
+
+# ---------------------------------------------------------------------------
+# Capability queries († basics.py mpi_built/nccl_built/gloo_built/...).
+# The reference answers "which backends were compiled in"; the TPU-native
+# equivalents answer the questions users actually asked of them: is there a
+# compiled data plane, a native control plane, a multi-host launcher.
+# ---------------------------------------------------------------------------
+
+def xla_built() -> bool:
+    """Always True: XLA is the data plane (≙ † ``nccl_built``)."""
+    return True
+
+
+def native_built() -> bool:
+    """True when the C++ control-plane extension loaded
+    (≙ † ``gloo_built``: the rendezvous/controller transport)."""
+    try:
+        from . import _native
+        _native.load()
+        return True
+    except Exception:
+        return False
+
+
+def mpi_built() -> bool:
+    """False: MPI has no role on TPU — the coordination service + XLA
+    collectives replace it († ``mpi_built``)."""
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    """The native KV/controller transport fills Gloo's role."""
+    return native_built()
+
+
+def nccl_built() -> int:
+    """XLA's ICI/DCN collectives fill NCCL's role (int like the reference,
+    which returns the NCCL version or 0)."""
+    return 1 if xla_built() else 0
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    """The engine's background thread model never requires
+    MPI_THREAD_MULTIPLE; collective submission is thread-safe
+    (≙ † ``mpi_threads_supported``)."""
+    return True
+
+
 # Optimizer/elastic API re-export (imported lazily so collective-only users
 # don't pay the optax import at package load).
 def __getattr__(name: str):

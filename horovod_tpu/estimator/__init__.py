@@ -64,9 +64,21 @@ class JaxModel:
 
         cols = to_columns(data, columns=list(self.feature_cols))
         feats = _features_matrix(cols, self.feature_cols)
-        apply = jax.jit(self.module.apply)
-        outs = [np.asarray(apply(self.params, feats[i:i + batch_size]))
-                for i in range(0, len(feats), batch_size)]
+        if getattr(self, "_apply", None) is None:
+            # One jit for the model's lifetime — predict() in a loop must
+            # hit XLA's compile cache, not rebuild it per call.
+            self._apply = jax.jit(self.module.apply)
+        outs = []
+        for i in range(0, len(feats), batch_size):
+            chunk = feats[i:i + batch_size]
+            pad = batch_size - len(chunk)
+            if pad > 0 and i > 0:
+                # Pad the final partial batch to the steady shape so it
+                # reuses the compiled program instead of recompiling.
+                chunk = np.concatenate(
+                    [chunk, np.zeros((pad,) + chunk.shape[1:], chunk.dtype)])
+            out = np.asarray(self._apply(self.params, chunk))
+            outs.append(out[:len(out) - pad] if pad > 0 and i > 0 else out)
         return np.concatenate(outs) if outs else np.empty((0,))
 
     def transform(self, df):
@@ -201,8 +213,10 @@ class JaxEstimator:
             epoch_loss = 0.0
             for i in range(steps):
                 idx = order[i * batch:(i + 1) * batch]
-                f = jax.device_put(jnp.asarray(feats[idx]), batch_shard)
-                y = jax.device_put(jnp.asarray(labels[idx]), batch_shard)
+                # device_put straight from numpy: one H2D transfer to the
+                # right sharding, not default-device then reshard.
+                f = jax.device_put(feats[idx], batch_shard)
+                y = jax.device_put(labels[idx], batch_shard)
                 params, opt_state, lval = train_step(params, opt_state, f, y)
                 epoch_loss += float(lval)
             entry = {"epoch": epoch, "loss": epoch_loss / max(steps, 1)}
@@ -292,14 +306,30 @@ class KerasEstimator:
         labels = _labels_array(cols, self.label_cols)
 
         callbacks = list(self.callbacks)
+        rank0 = True
         if hvd.is_initialized() and hvd.size() > 1:
-            # Shard rows by rank († per-worker partitions) and attach the
-            # coordination callbacks.
+            # Shard rows by rank († per-worker partitions), equalized so
+            # every rank runs the SAME number of batches — unequal counts
+            # deadlock any per-batch collective on the surplus batch
+            # († steps_per_epoch equalization in the reference estimator).
             r, s = hvd.cross_rank(), hvd.cross_size()
-            feats, labels = feats[r::s], labels[r::s]
+            rank0 = r == 0
+            per_rank = len(feats) // s
+            if per_rank == 0:
+                raise ValueError(
+                    f"{len(feats)} rows cannot shard over {s} ranks")
+            feats, labels = feats[r::s][:per_rank], labels[r::s][:per_rank]
             callbacks = [hvd_keras.BroadcastGlobalVariablesCallback(0),
                          hvd_keras.MetricAverageCallback()] + callbacks
-        if self.store is not None:
+            # Wire gradient averaging († 'wires the distributed optimizer'):
+            # without it ranks train independently and diverge after the
+            # step-0 broadcast.
+            opt = getattr(self.model, "optimizer", None)
+            if opt is not None and not hasattr(opt, "_hvd_op"):
+                self.model.optimizer = hvd_keras.DistributedOptimizer(opt)
+        if self.store is not None and rank0:
+            # rank 0 only: concurrent writers on a shared store corrupt the
+            # checkpoint († checkpoint on rank 0).
             import keras
             import os
             path = os.path.join(

@@ -43,13 +43,16 @@ class LocalStore:
         return path
 
 
-def _read_parquet(path: str) -> dict[str, np.ndarray]:
+def _read_parquet(path: str,
+                  columns: Optional[Sequence[str]] = None
+                  ) -> dict[str, np.ndarray]:
     import pyarrow.parquet as pq
     files = sorted(glob.glob(os.path.join(path, "*.parquet"))) \
         if os.path.isdir(path) else [path]
     if not files:
         raise FileNotFoundError(f"no parquet files under {path}")
-    tables = [pq.read_table(f) for f in files]
+    tables = [pq.read_table(f, columns=list(columns) if columns else None)
+              for f in files]
     import pyarrow as pa
     table = pa.concat_tables(tables)
     out = {}
@@ -73,12 +76,26 @@ def to_columns(data: Any,
     Accepts a pandas DataFrame, a dict of array-likes, a structured numpy
     array, or a path to a parquet file/directory.
     """
+    # Filter to the requested columns BEFORE conversion: an unrelated
+    # ragged object column must not crash (or pay for) a fit that never
+    # reads it.
+    def _select(names) -> list:
+        if columns is None:
+            return list(names)
+        missing = [c for c in columns if c not in set(names)]
+        if missing:
+            raise KeyError(f"columns {missing} not in data "
+                           f"(have {sorted(names)})")
+        return list(columns)
+
     if isinstance(data, str):
-        cols = _read_parquet(data)
+        cols = _read_parquet(data, columns)
+        cols = {c: cols[c] for c in _select(cols.keys())}
     elif isinstance(data, dict):
-        cols = {k: np.asarray(v) for k, v in data.items()}
+        cols = {k: np.asarray(data[k]) for k in _select(data.keys())}
     elif isinstance(data, np.ndarray) and data.dtype.names:
-        cols = {n: np.asarray(data[n]) for n in data.dtype.names}
+        cols = {n: np.asarray(data[n])
+                for n in _select(data.dtype.names)}
     else:
         try:
             import pandas as pd
@@ -86,7 +103,7 @@ def to_columns(data: Any,
             pd = None
         if pd is not None and isinstance(data, pd.DataFrame):
             cols = {}
-            for name in data.columns:
+            for name in _select(data.columns):
                 series = data[name]
                 if series.dtype == object:
                     # Column of fixed-size vectors (the Spark ML "features"
@@ -100,12 +117,6 @@ def to_columns(data: Any,
                 f"unsupported data type {type(data).__name__}: expected "
                 "DataFrame, dict of arrays, structured array, or parquet "
                 "path")
-    if columns is not None:
-        missing = [c for c in columns if c not in cols]
-        if missing:
-            raise KeyError(f"columns {missing} not in data "
-                           f"(have {sorted(cols)})")
-        cols = {c: cols[c] for c in columns}
     sizes = {k: len(v) for k, v in cols.items()}
     if len(set(sizes.values())) > 1:
         raise ValueError(f"ragged columns: {sizes}")

@@ -146,3 +146,23 @@ class LearningRateScheduleCallback(_Callback):
             return
         self.model.optimizer.learning_rate = \
             self.initial_lr * self.multiplier(epoch)
+
+
+def DistributedOptimizer(optimizer, **kwargs):
+    """† ``horovod.keras.DistributedOptimizer``: wrap a Keras optimizer so
+    gradient application allreduces first.
+
+    Keras 3 on the TF backend routes through the TF binding's wrapper; on
+    the JAX backend the native in-jit path
+    (:class:`horovod_tpu.optim.DistributedOptimizer`) is the idiomatic
+    answer and this raises with that pointer rather than silently training
+    un-averaged.
+    """
+    import keras as _keras
+    if _keras.backend.backend() != "tensorflow":
+        raise RuntimeError(
+            "keras.DistributedOptimizer supports the tensorflow backend; "
+            "on the jax backend use horovod_tpu.DistributedOptimizer "
+            "(optax transform, reduction inside jit) instead")
+    from horovod_tpu.tensorflow import DistributedOptimizer as _tf_dist
+    return _tf_dist(optimizer, **kwargs)

@@ -1,0 +1,342 @@
+"""TensorFlow binding: Horovod's TF API surface on the TPU-native runtime.
+
+† ``horovod/tensorflow/__init__.py`` + ``mpi_ops.cc`` + ``mpi_ops.py``:
+``hvd.allreduce/allgather/broadcast/alltoall`` on ``tf.Tensor``,
+``DistributedGradientTape`` (TF2/eager gradient allreduce),
+``DistributedOptimizer`` (Keras-optimizer wrap; local gradient aggregation
+via ``backward_passes_per_step`` ≙ † ``gradient_aggregation_eager.py``),
+``broadcast_variables`` (step-0 sync of †3.3).
+
+Architecture: the reference registers TF custom C++ ``AsyncOpKernel``s that
+enqueue into its background runtime.  Here the runtime's data plane is XLA
+itself, so TF tensors bridge host-side (numpy) into the engine's per-rank
+arrays; inside ``tf.function`` graphs the bridge rides ``tf.py_function``
+(an eager host-call — the moral equivalent of the reference's async kernel
+handing off to the background thread).  ``jit_compile=True`` graphs cannot
+host-call; for fully-compiled training use the JAX path, which is this
+framework's native mode (the reference's own XLA story,
+† ``xla_mpi_ops.cc``, was likewise an escape hatch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu as _hvd
+from horovod_tpu import (  # noqa: F401  (re-exported basics †basics.py)
+    Average,
+    Sum,
+    Min,
+    Max,
+    Product,
+    Adasum,
+    ReduceOp,
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    broadcast_object,
+    join,
+)
+from horovod_tpu.ops.compression import Compression  # noqa: F401
+
+
+def _to_per_rank(arr: np.ndarray):
+    reps = _hvd.local_size()
+    return _hvd.from_local(np.repeat(arr[None], reps, axis=0))
+
+
+def _np(x) -> np.ndarray:
+    return np.array(_hvd.to_numpy(x))
+
+
+# ---------------------------------------------------------------------------
+# Eager verbs
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor: tf.Tensor, op: ReduceOp = Average,
+              name: Optional[str] = None) -> tf.Tensor:
+    """† ``hvd.allreduce`` on a TF tensor (eager or inside ``tf.function``
+    via host-call)."""
+    del name
+    if tf.executing_eagerly() and not isinstance(tensor, tf.Variable) \
+            and not hasattr(tensor, "graph"):
+        out = _np(_hvd.allreduce(_to_per_rank(np.asarray(tensor)), op))
+        return tf.constant(out, dtype=tensor.dtype)
+    dtype = tensor.dtype
+
+    def _host(t):
+        out = _np(_hvd.allreduce(_to_per_rank(t.numpy()), op))
+        return tf.constant(out.astype(dtype.as_numpy_dtype))
+
+    result = tf.py_function(_host, inp=[tensor], Tout=dtype)
+    result.set_shape(tensor.shape)
+    return result
+
+
+def allgather(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
+    del name
+    out = _np(_hvd.allgather(_to_per_rank(np.asarray(tensor))))
+    return tf.constant(out, dtype=tensor.dtype)
+
+
+def broadcast(tensor: tf.Tensor, root_rank: int,
+              name: Optional[str] = None) -> tf.Tensor:
+    del name
+    out = _np(_hvd.broadcast(_to_per_rank(np.asarray(tensor)), root_rank))
+    return tf.constant(out, dtype=tensor.dtype)
+
+
+def alltoall(tensor: tf.Tensor, splits: Optional[Sequence[int]] = None,
+             name: Optional[str] = None) -> tf.Tensor:
+    del name
+    out = _np(_hvd.alltoall(_to_per_rank(np.asarray(tensor)), splits))
+    return tf.constant(out, dtype=tensor.dtype)
+
+
+def reducescatter(tensor: tf.Tensor, op: ReduceOp = Sum,
+                  name: Optional[str] = None) -> tf.Tensor:
+    del name
+    out = _np(_hvd.reducescatter(_to_per_rank(np.asarray(tensor)), op))
+    return tf.constant(out, dtype=tensor.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Async verbs
+# ---------------------------------------------------------------------------
+
+def allreduce_async(tensor: tf.Tensor, op: ReduceOp = Average,
+                    name: Optional[str] = None):
+    return _hvd.allreduce_async(_to_per_rank(np.asarray(tensor)), op,
+                                name=name)
+
+
+def synchronize(handle) -> tf.Tensor:
+    return tf.constant(_np(_hvd.synchronize(handle)))
+
+
+def poll(handle) -> bool:
+    return _hvd.poll(handle)
+
+
+# ---------------------------------------------------------------------------
+# Variable sync († broadcast_variables / BroadcastGlobalVariablesCallback)
+# ---------------------------------------------------------------------------
+
+def broadcast_variables(variables: Sequence[tf.Variable],
+                        root_rank: int = 0) -> None:
+    """In-place broadcast of TF variables from ``root_rank``
+    († ``hvd.broadcast_variables`` — the step-0 weight sync).
+
+    One pytree broadcast for all variables, not one collective each (a large
+    model has thousands of variables; per-tensor multihost round-trips would
+    dominate startup — same batching the torch binding does).
+    """
+    variables = list(variables)
+    if not variables:
+        return
+    if tf.executing_eagerly():
+        tensors = {str(i): np.asarray(v) for i, v in enumerate(variables)}
+        synced = _hvd.broadcast_parameters(tensors, root_rank=root_rank)
+        for i, v in enumerate(variables):
+            v.assign(tf.constant(_np(synced[str(i)]),
+                                 dtype=v.dtype, shape=v.shape))
+        return
+    # tf.function graph: read values as graph tensors, broadcast in one
+    # host-call, assign back (runs on first-batch sync inside @tf.function,
+    # the reference's documented pattern).
+    values = [tf.convert_to_tensor(v) for v in variables]
+
+    def _host(*vals):
+        tensors = {str(i): val.numpy() for i, val in enumerate(vals)}
+        synced = _hvd.broadcast_parameters(tensors, root_rank=root_rank)
+        return [tf.constant(_np(synced[str(i)])) for i in range(len(vals))]
+
+    out = tf.py_function(_host, inp=values, Tout=[v.dtype for v in values])
+    if not isinstance(out, (list, tuple)):
+        out = [out]
+    for v, r in zip(variables, out):
+        r.set_shape(v.shape)
+        v.assign(r)
+
+
+# ---------------------------------------------------------------------------
+# DistributedGradientTape († _DistributedGradientTape, TF2 eager hot path)
+# ---------------------------------------------------------------------------
+
+class _DistributedGradientTape:
+    """Wraps ``tf.GradientTape``; ``gradient()`` returns allreduced grads.
+
+    All gradients ship through ONE fused engine cycle
+    († fusion buffer: the tape's grads are exactly the many-small-tensors
+    case the fusion path exists for).
+    """
+
+    def __init__(self, tape: tf.GradientTape, op: ReduceOp = Average,
+                 compression=Compression.none) -> None:
+        self._tape = tape
+        self._op = op
+        self._compression = compression
+
+    def __getattr__(self, name):
+        return getattr(self._tape, name)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources,
+                                    output_gradients=output_gradients)
+        flat = tf.nest.flatten(grads)
+        reduced = _grouped_allreduce_grads(flat, self._op, self._compression)
+        return tf.nest.pack_sequence_as(grads, reduced)
+
+
+def DistributedGradientTape(tape: tf.GradientTape, op: ReduceOp = Average,
+                            compression=Compression.none
+                            ) -> _DistributedGradientTape:
+    """† ``hvd.DistributedGradientTape``."""
+    return _DistributedGradientTape(tape, op=op, compression=compression)
+
+
+def _grouped_allreduce_grads(flat_grads, op: ReduceOp, compression):
+    """Allreduce a flat gradient list in one fused cycle; None passes
+    through (untrained variables yield None grads, † _allreduce_grads).
+
+    Inside ``tf.function`` graphs the whole list rides ONE host-call
+    (a single fused engine cycle ≙ the fusion buffer)."""
+    if not tf.executing_eagerly():
+        live = [tf.convert_to_tensor(g) for g in flat_grads if g is not None]
+        if not live:
+            return list(flat_grads)
+        dtypes = [g.dtype for g in live]
+
+        def _host(*gs):
+            outs = _grouped_allreduce_grads_eager(list(gs), op, compression)
+            return [tf.constant(np.asarray(o)) for o in outs]
+
+        reduced_live = tf.py_function(_host, inp=live, Tout=dtypes)
+        if not isinstance(reduced_live, (list, tuple)):
+            reduced_live = [reduced_live]
+        it = iter(reduced_live)
+        out = []
+        for g in flat_grads:
+            if g is None:
+                out.append(None)
+            else:
+                r = next(it)
+                if isinstance(g, tf.Tensor):
+                    r.set_shape(g.shape)
+                out.append(r)
+        return out
+    return _grouped_allreduce_grads_eager(flat_grads, op, compression)
+
+
+def _grouped_allreduce_grads_eager(flat_grads, op: ReduceOp, compression):
+    import jax.numpy as jnp
+    handles: list = []
+    ctxs: list = []
+    idx: list[int] = []
+    for i, g in enumerate(flat_grads):
+        if g is None:
+            continue
+        arr = np.asarray(g.values if isinstance(g, tf.IndexedSlices) else g)
+        if isinstance(g, tf.IndexedSlices):
+            # † sparse_as_dense: densify indexed slices before the ring.
+            dense = np.zeros(g.dense_shape.numpy(), arr.dtype)
+            np.add.at(dense, g.indices.numpy(), arr)
+            arr = dense
+        wire, ctx = compression.compress(jnp.asarray(arr))
+        handles.append(_hvd.allreduce_async(
+            _to_per_rank(np.asarray(wire)), op, name=f"tf.grad.{i}"))
+        ctxs.append(ctx)
+        idx.append(i)
+    out = list(flat_grads)
+    results = [_hvd.synchronize(h) for h in handles]
+    for i, res, ctx in zip(idx, results, ctxs):
+        dec = compression.decompress(res, ctx)
+        g = flat_grads[i]
+        out[i] = tf.constant(_np(dec), dtype=g.dtype)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DistributedOptimizer († Keras optimizer wrap + gradient aggregation)
+# ---------------------------------------------------------------------------
+
+def DistributedOptimizer(optimizer, op: ReduceOp = Average,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         name: Optional[str] = None):
+    """† ``hvd.DistributedOptimizer``: returns an optimizer of the same
+    class whose gradient application first allreduces across ranks.
+
+    Works in eager custom loops and in ``model.fit`` graphs (host-call);
+    ``backward_passes_per_step > 1`` accumulates locally and applies the
+    averaged update every Nth call († ``LocalGradientAggregationHelper``).
+    """
+    del name
+    cls = optimizer.__class__
+    dist_cls = type("Distributed" + cls.__name__, (cls,), {
+        "_hvd_op": op,
+        "_hvd_compression": compression,
+        "_hvd_bpps": backward_passes_per_step,
+        "apply_gradients": _dist_apply_gradients,
+    })
+    new = dist_cls.from_config(optimizer.get_config())
+    new._hvd_agg_buf = None
+    new._hvd_agg_count = 0
+    return new
+
+
+def _dist_apply_gradients(self, grads_and_vars, *args, **kwargs):
+    grads_and_vars = list(grads_and_vars)
+    grads = [g for g, _ in grads_and_vars]
+    tvars = [v for _, v in grads_and_vars]
+    eager = tf.executing_eagerly() and all(
+        not hasattr(g, "graph") for g in grads if g is not None)
+    if self._hvd_bpps > 1:
+        if not eager:
+            raise RuntimeError(
+                "backward_passes_per_step > 1 requires eager execution "
+                "(run_eagerly=True) in this binding")
+        if self._hvd_agg_buf is None:
+            self._hvd_agg_buf = [
+                None if g is None else np.asarray(g) for g in grads]
+        else:
+            for i, g in enumerate(grads):
+                if g is not None:
+                    self._hvd_agg_buf[i] = self._hvd_agg_buf[i] + np.asarray(g)
+        self._hvd_agg_count += 1
+        if self._hvd_agg_count < self._hvd_bpps:
+            return None  # † aggregation step: no variable update yet
+        grads = [None if b is None else tf.constant(b / self._hvd_bpps)
+                 for b in self._hvd_agg_buf]
+        self._hvd_agg_buf = None
+        self._hvd_agg_count = 0
+
+    reduced = _grouped_allreduce_grads(grads, self._hvd_op,
+                                       self._hvd_compression)
+    return super(type(self), self).apply_gradients(
+        zip(reduced, tvars), *args, **kwargs)
+
+
+def __getattr__(name: str):
+    if name == "elastic":
+        # † ``import horovod.tensorflow as hvd; hvd.elastic.TensorFlowKerasState``
+        import importlib
+        return importlib.import_module("horovod_tpu.tensorflow.elastic")
+    raise AttributeError(
+        f"module 'horovod_tpu.tensorflow' has no attribute {name!r}")

@@ -1,0 +1,44 @@
+"""† ``horovod/tensorflow/keras/`` — the tf.keras-flavored surface.
+
+Re-exports the Keras callbacks (shared with :mod:`horovod_tpu.keras`, same
+as the reference's shared ``horovod/_keras/``) plus the TF
+``DistributedOptimizer`` and ``broadcast_variables``.
+"""
+
+from horovod_tpu.keras import (  # noqa: F401
+    BroadcastGlobalVariablesCallback,
+    MetricAverageCallback,
+    LearningRateWarmupCallback,
+    LearningRateScheduleCallback,
+)
+from horovod_tpu.tensorflow import (  # noqa: F401
+    Average,
+    Sum,
+    Min,
+    Max,
+    Product,
+    Adasum,
+    ReduceOp,
+    Compression,
+    DistributedOptimizer,
+    allreduce,
+    allgather,
+    broadcast,
+    broadcast_variables,
+    broadcast_object,
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    join,
+)
+
+# † horovod/keras callbacks module alias (hvd.callbacks.*)
+from horovod_tpu import keras as _k
+
+callbacks = _k

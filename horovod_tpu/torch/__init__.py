@@ -263,3 +263,12 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
         optimizer, named_parameters=named_parameters, op=op,
         compression=compression,
         backward_passes_per_step=backward_passes_per_step)
+
+
+def __getattr__(name: str):
+    if name == "elastic":
+        # † ``import horovod.torch as hvd; hvd.elastic.run`` — lazy so the
+        # elastic machinery isn't paid for by collective-only users.
+        import importlib
+        return importlib.import_module("horovod_tpu.torch.elastic")
+    raise AttributeError(f"module 'horovod_tpu.torch' has no attribute {name!r}")

@@ -1,0 +1,101 @@
+"""Torch elastic state († ``horovod/torch/elastic/state.py``).
+
+``TorchState(model=..., optimizer=..., epoch=0, batch=0)``:
+
+- ``commit()`` deep-copies module/optimizer ``state_dict``s host-side (the
+  reference's host-RAM snapshot — survives device teardown),
+- ``restore()`` rolls back to the last commit,
+- ``sync()`` broadcasts current values from rank 0 (joining workers adopt
+  the incumbent weights; † ``TorchState.sync``).
+
+Plain picklable attributes (epoch, batch, ...) follow ``ObjectState``
+semantics.  Usable with the shared ``@hvd.elastic.run`` decorator and
+``ElasticSampler`` (re-exported here so ``import horovod_tpu.torch as hvd;
+hvd.elastic.*`` reads like the reference).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+import torch
+
+from horovod_tpu.elastic import (  # noqa: F401  (reference-shaped surface)
+    ElasticSampler,
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+    ObjectState,
+    State,
+    run,
+)
+from . import broadcast_optimizer_state, broadcast_parameters
+
+
+class TorchState(State):
+    """† ``TorchState``: handlers per value type — ``nn.Module`` and
+    ``Optimizer`` snapshot/sync via their ``state_dict``; everything else
+    via pickle-able object semantics."""
+
+    def __init__(self, model: torch.nn.Module | None = None,
+                 optimizer: torch.optim.Optimizer | None = None,
+                 **kwargs: Any) -> None:
+        super().__init__()
+        self._model = model
+        self._optimizer = optimizer
+        self._objects: dict[str, Any] = dict(kwargs)
+        self._saved: dict[str, Any] = {}
+        self.save()
+
+    # -- attribute plumbing: state.epoch etc. read/write the object dict --
+
+    def __getattr__(self, name: str) -> Any:
+        if name == "model":
+            return self.__dict__["_model"]
+        if name == "optimizer":
+            return self.__dict__["_optimizer"]
+        objects = self.__dict__.get("_objects", {})
+        if name in objects:
+            return objects[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name.startswith("_"):
+            super().__setattr__(name, value)
+        elif name == "model":
+            self._model = value
+        elif name == "optimizer":
+            self._optimizer = value
+        else:
+            self._objects[name] = value
+
+    # -- State protocol --
+
+    def save(self) -> None:
+        snap: dict[str, Any] = {
+            "objects": copy.deepcopy(self._objects)}
+        if self._model is not None:
+            snap["model"] = {
+                k: v.detach().clone() if isinstance(v, torch.Tensor) else
+                copy.deepcopy(v)
+                for k, v in self._model.state_dict().items()}
+        if self._optimizer is not None:
+            snap["optimizer"] = copy.deepcopy(self._optimizer.state_dict())
+        self._saved = snap
+
+    def restore(self) -> None:
+        self._objects = copy.deepcopy(self._saved["objects"])
+        if self._model is not None and "model" in self._saved:
+            self._model.load_state_dict(self._saved["model"])
+        if self._optimizer is not None and "optimizer" in self._saved:
+            self._optimizer.load_state_dict(
+                copy.deepcopy(self._saved["optimizer"]))
+
+    def sync(self) -> None:
+        import horovod_tpu as hvd
+        if self._model is not None:
+            broadcast_parameters(self._model.state_dict(), root_rank=0)
+        if self._optimizer is not None:
+            broadcast_optimizer_state(self._optimizer, root_rank=0)
+        self._objects = hvd.broadcast_object(self._objects, root_rank=0)
+        self.save()

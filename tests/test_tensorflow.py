@@ -1,0 +1,196 @@
+"""TensorFlow binding tests.
+
+Mirrors † ``test/parallel/test_tensorflow.py`` (allreduce semantics across
+dtypes, DistributedGradientTape gradient averaging) and
+† ``test_tensorflow2_keras.py`` (DistributedOptimizer inside model.fit).
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.tensorflow as hvd_tf  # noqa: E402
+
+N = 8  # fake devices; single process drives all ranks with the same tensor
+
+
+def test_tf_allreduce_sum_tiles_local_ranks():
+    t = tf.constant([1.0, 2.0, 3.0])
+    out = hvd_tf.allreduce(t, hvd.Sum)
+    assert np.allclose(out.numpy(), np.array([1, 2, 3], np.float32) * N)
+
+
+def test_tf_allreduce_average_identity():
+    t = tf.constant(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+    out = hvd_tf.allreduce(t, hvd.Average)
+    assert np.allclose(out.numpy(), t.numpy(), atol=1e-6)
+
+
+def test_tf_allreduce_inside_tf_function():
+    @tf.function
+    def fn(x):
+        return hvd_tf.allreduce(x, hvd.Sum)
+
+    out = fn(tf.constant([2.0, 4.0]))
+    assert np.allclose(out.numpy(), [2.0 * N, 4.0 * N])
+
+
+def test_tf_broadcast_and_allgather():
+    t = tf.constant([[5, 6]], dtype=tf.int32)
+    assert np.array_equal(hvd_tf.broadcast(t, root_rank=2).numpy(), [[5, 6]])
+    gathered = hvd_tf.allgather(t)
+    assert gathered.shape == (N, 2)
+
+
+def test_tf_async_roundtrip():
+    h = hvd_tf.allreduce_async(tf.ones((4,)), hvd.Sum, name="tf.async")
+    out = hvd_tf.synchronize(h)
+    assert np.allclose(out.numpy(), np.full((4,), float(N)))
+
+
+def test_tf_broadcast_variables_inplace():
+    v1 = tf.Variable([1.0, 2.0])
+    v2 = tf.Variable([[3.0]])
+    hvd_tf.broadcast_variables([v1, v2], root_rank=0)
+    assert np.allclose(v1.numpy(), [1.0, 2.0])
+    assert np.allclose(v2.numpy(), [[3.0]])
+
+
+def test_tf_distributed_gradient_tape_matches_plain():
+    x = tf.constant(np.random.RandomState(1).randn(8, 4).astype(np.float32))
+    w = tf.Variable(np.random.RandomState(2).randn(4, 1).astype(np.float32))
+
+    with tf.GradientTape() as plain_tape:
+        loss = tf.reduce_mean(tf.square(x @ w))
+    plain_grad = plain_tape.gradient(loss, [w])[0]
+
+    with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = tf.reduce_mean(tf.square(x @ w))
+    dist_grad = tape.gradient(loss, [w])[0]
+
+    # Average over identical ranks == plain gradient.
+    assert np.allclose(dist_grad.numpy(), plain_grad.numpy(), atol=1e-5)
+
+
+def test_tf_gradient_tape_and_broadcast_inside_tf_function():
+    # † the reference's documented TF2 pattern: DistributedGradientTape +
+    # first-batch broadcast_variables, all inside one @tf.function.
+    w = tf.Variable([[1.0], [2.0]])
+    x = tf.constant([[3.0, 4.0]])
+
+    @tf.function
+    def step(first):
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(x @ w)
+        dtape = hvd_tf.DistributedGradientTape(tape)
+        grads = dtape.gradient(loss, [w])
+        if first:
+            hvd_tf.broadcast_variables([w], root_rank=0)
+        return grads[0]
+
+    g = step(tf.constant(True))
+    assert np.allclose(g.numpy(), [[3.0], [4.0]])
+    assert np.allclose(w.numpy(), [[1.0], [2.0]])
+
+
+def test_tf_gradient_tape_none_grads_pass_through():
+    w = tf.Variable([1.0])
+    unused = tf.Variable([2.0])
+    with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = tf.reduce_sum(w * 3.0)
+    grads = tape.gradient(loss, [w, unused])
+    assert grads[1] is None
+    assert np.allclose(grads[0].numpy(), [3.0])
+
+
+def _make_model(seed=0):
+    import keras
+    keras.utils.set_random_seed(seed)
+    return keras.Sequential([
+        keras.layers.Input((4,)),
+        keras.layers.Dense(3, activation="relu"),
+        keras.layers.Dense(1),
+    ])
+
+
+def test_tf_distributed_optimizer_eager_matches_plain():
+    import keras
+    x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(16, 1).astype(np.float32)
+
+    ref = _make_model()
+    ref_opt = keras.optimizers.SGD(learning_rate=0.1)
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_mean(tf.square(ref(x) - y))
+    ref_opt.apply_gradients(
+        zip(tape.gradient(loss, ref.trainable_variables),
+            ref.trainable_variables))
+
+    dist = _make_model()
+    opt = hvd_tf.DistributedOptimizer(keras.optimizers.SGD(learning_rate=0.1))
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_mean(tf.square(dist(x) - y))
+    opt.apply_gradients(
+        zip(tape.gradient(loss, dist.trainable_variables),
+            dist.trainable_variables))
+
+    for a, b in zip(ref.get_weights(), dist.get_weights()):
+        assert np.allclose(a, b, atol=1e-5)
+
+
+def test_tf_distributed_optimizer_model_fit_graph_mode():
+    import keras
+    x = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(32, 1).astype(np.float32)
+
+    model = _make_model()
+    model.compile(
+        optimizer=hvd_tf.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=0.05)),
+        loss="mse")
+    before = [w.copy() for w in model.get_weights()]
+    hist = model.fit(x, y, batch_size=16, epochs=1, verbose=0)
+    after = model.get_weights()
+    assert np.isfinite(hist.history["loss"][0])
+    assert any(not np.allclose(a, b) for a, b in zip(before, after))
+
+
+def test_tf_distributed_optimizer_backward_passes_per_step():
+    import keras
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 1).astype(np.float32)
+
+    # Reference: one step on the mean of two micro-batch gradients.
+    ref = _make_model()
+    ref_opt = keras.optimizers.SGD(learning_rate=0.1)
+    grads_sum = None
+    for sl in (slice(0, 4), slice(4, 8)):
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean(tf.square(ref(x[sl]) - y[sl]))
+        gs = tape.gradient(loss, ref.trainable_variables)
+        grads_sum = gs if grads_sum is None else [
+            a + b for a, b in zip(grads_sum, gs)]
+    ref_opt.apply_gradients(
+        zip([g / 2 for g in grads_sum], ref.trainable_variables))
+
+    dist = _make_model()
+    opt = hvd_tf.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1), backward_passes_per_step=2)
+    for sl in (slice(0, 4), slice(4, 8)):
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean(tf.square(dist(x[sl]) - y[sl]))
+        opt.apply_gradients(
+            zip(tape.gradient(loss, dist.trainable_variables),
+                dist.trainable_variables))
+
+    for a, b in zip(ref.get_weights(), dist.get_weights()):
+        assert np.allclose(a, b, atol=1e-5)
+
+
+def test_tf_keras_module_surface():
+    import horovod_tpu.tensorflow.keras as hvd_tfk
+    assert hvd_tfk.size() == N
+    assert callable(hvd_tfk.DistributedOptimizer)
+    assert hvd_tfk.callbacks.BroadcastGlobalVariablesCallback is not None
