@@ -1,0 +1,124 @@
+"""BERT-Large masked-LM pretraining with the TF/Keras binding — the
+reference's BERT config († BASELINE "BERT-Large pretraining (TF Keras hvd
+callback → XLA allreduce)"; upstream pattern as in
+``examples/tensorflow2/tensorflow2_keras_mnist.py`` scaled to BERT):
+``hvd.DistributedOptimizer`` wraps the Keras optimizer so every gradient is
+allreduced on the XLA data plane, ``BroadcastGlobalVariablesCallback``
+syncs step-0 weights, ``MetricAverageCallback`` averages epoch metrics,
+LR warmup scales with world size.
+
+No dataset in the image → synthetic MLM batches (random tokens, 15% of
+positions masked to ``[MASK]`` and predicted).  Defaults are smoke-sized;
+``--bert-large`` selects the real 24-layer/1024-hidden geometry.
+
+Run:  hvdrun -np 2 python examples/tf_keras_bert_pretrain.py
+"""
+
+import argparse
+
+import numpy as np
+
+import horovod_tpu.tensorflow.keras as hvd
+
+MASK_ID = 1  # token id reserved for [MASK]
+
+
+def build_bert(vocab: int, seq: int, d_model: int, n_layers: int,
+               n_heads: int, d_ff: int):
+    """Keras functional BERT encoder with an MLM head (weight-tied soft
+    geometry: per-position vocab logits)."""
+    import keras
+    from keras import layers
+
+    tokens = keras.Input((seq,), dtype="int32", name="tokens")
+    pos = np.arange(seq)[None, :]
+    h = layers.Embedding(vocab, d_model, name="tok_embed")(tokens)
+    h = h + layers.Embedding(seq, d_model, name="pos_embed")(
+        keras.ops.convert_to_tensor(pos))
+    h = layers.LayerNormalization(epsilon=1e-12)(h)
+    for i in range(n_layers):
+        a = layers.MultiHeadAttention(n_heads, d_model // n_heads,
+                                      name=f"attn_{i}")(h, h)
+        h = layers.LayerNormalization(epsilon=1e-12)(h + a)
+        f = layers.Dense(d_ff, activation="gelu", name=f"ff_up_{i}")(h)
+        f = layers.Dense(d_model, name=f"ff_down_{i}")(f)
+        h = layers.LayerNormalization(epsilon=1e-12)(h + f)
+    logits = layers.Dense(vocab, name="mlm_head")(h)
+    return keras.Model(tokens, logits, name="bert")
+
+
+def synthetic_mlm(rng, n, seq, vocab):
+    """Random token streams; 15% masked.  Labels are -100 (ignored) on
+    unmasked positions, original id on masked ones."""
+    tokens = rng.randint(2, vocab, size=(n, seq)).astype("int32")
+    labels = np.full_like(tokens, -100)
+    mask = rng.rand(n, seq) < 0.15
+    labels[mask] = tokens[mask]
+    tokens[mask] = MASK_ID
+    return tokens, labels
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--bert-large", action="store_true",
+                   help="real 24x1024x16 geometry (default: smoke-sized)")
+    p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="default: 32 smoke / 512 with --bert-large")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--samples", type=int, default=64)
+    p.add_argument("--base-lr", type=float, default=1e-4)
+    args = p.parse_args()
+
+    import keras
+
+    hvd.init()
+
+    if args.bert_large:
+        seq = args.seq_len if args.seq_len is not None else 512
+        dims = dict(vocab=30522, seq=seq, d_model=1024,
+                    n_layers=24, n_heads=16, d_ff=4096)
+    else:
+        seq = args.seq_len if args.seq_len is not None else 32
+        dims = dict(vocab=args.vocab, seq=seq, d_model=64,
+                    n_layers=2, n_heads=4, d_ff=128)
+
+    keras.utils.set_random_seed(42)
+    model = build_bert(dims["vocab"], dims["seq"], dims["d_model"],
+                       dims["n_layers"], dims["n_heads"], dims["d_ff"])
+
+    def mlm_loss(y_true, y_pred):
+        """Sparse CE over masked positions only (-100 = ignore)."""
+        ops = keras.ops
+        valid = ops.cast(ops.not_equal(y_true, -100), y_pred.dtype)
+        y = ops.maximum(y_true, 0)
+        ce = keras.losses.sparse_categorical_crossentropy(
+            y, y_pred, from_logits=True)
+        return ops.sum(ce * valid) / ops.maximum(ops.sum(valid), 1.0)
+
+    # † scale lr by size; wrap optimizer so grads allreduce on XLA.
+    scaled_lr = args.base_lr * hvd.size()
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.AdamW(learning_rate=scaled_lr, weight_decay=0.01))
+    model.compile(optimizer=opt, loss=mlm_loss)
+
+    rng = np.random.RandomState(1234 + hvd.rank())  # per-rank data shard
+    x, y = synthetic_mlm(rng, args.samples, dims["seq"], dims["vocab"])
+
+    steps = max(1, args.samples // args.batch_size)
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(
+            initial_lr=scaled_lr, warmup_epochs=1, steps_per_epoch=steps),
+    ]
+    model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs,
+              callbacks=callbacks,
+              verbose=2 if hvd.rank() == 0 else 0)
+    if hvd.rank() == 0:
+        print("DONE bert", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -4,6 +4,7 @@
 Run multi-process (one rank per process, the reference topology):
 
     python -m horovod_tpu.runner -np 2 -- python examples/torch_mnist.py
+(add ``--platform cpu`` before ``--`` on a CPU dev rig)
 """
 
 import os
@@ -11,7 +12,9 @@ import os
 os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
     " --xla_force_host_platform_device_count=1"
 import jax  # noqa: E402
-if os.environ.get("HVDTPU_CROSS_SIZE"):
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # Env alone loses to the image's sitecustomize pin; config wins.
+    # Under hvdrun, pass --platform cpu instead (applied at init()).
     jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402

@@ -23,6 +23,15 @@ _TRUE = {"1", "true", "yes", "on"}
 _FALSE = {"0", "false", "no", "off", ""}
 
 
+def _parse_platform(v: str) -> str:
+    # jax_platforms is case-sensitive; validate here so a typo fails at the
+    # knob, not deep inside jax backend init.
+    lv = v.strip().lower()
+    if lv not in ("tpu", "cpu"):
+        raise ValueError(f"platform must be 'tpu' or 'cpu', got {v!r}")
+    return lv
+
+
 def _parse_bool(v: str) -> bool:
     lv = v.strip().lower()
     if lv in _TRUE:
@@ -103,6 +112,10 @@ class Config:
     dp_axis_name: str = "hvd"
     # Force CPU backend for collectives (dev rig); normally inherited from JAX.
     cpu_operations: bool = False
+    # JAX platform to select before backend init ("tpu"/"cpu"); None = auto.
+    # The launcher's --platform flag injects this so worker scripts need no
+    # per-script jax.config boilerplate.
+    platform: Optional[str] = None
 
 
 # (field name, env suffix, parser) — the env surface, mirroring the
@@ -126,6 +139,7 @@ _ENV_TABLE = [
     ("hierarchical_allgather", "HIERARCHICAL_ALLGATHER", _parse_bool),
     ("hierarchical_local_size", "HIERARCHICAL_LOCAL_SIZE", int),
     ("elastic", "ELASTIC", _parse_bool),
+    ("platform", "PLATFORM", _parse_platform),
     ("coordinator_addr", "COORDINATOR_ADDR", str),
     ("controller_addr", "CONTROLLER_ADDR", str),
     ("rendezvous_addr", "RENDEZVOUS_ADDR", str),
@@ -137,6 +151,8 @@ _ENV_TABLE = [
     ("cross_size_env", "CROSS_SIZE", int),
     ("cpu_operations", "CPU_OPERATIONS", _parse_bool),
 ]
+
+_FIELD_PARSERS = {field: parser for field, _, parser in _ENV_TABLE}
 
 _PREFIXES = ("HVDTPU_", "HOROVOD_")
 
@@ -186,12 +202,21 @@ def from_yaml(path: str, base: Optional[Config] = None) -> Config:
             if key not in valid:
                 raise ValueError(f"{path}:{lineno}: unknown knob {key!r}")
             current = getattr(cfg, key)
+            table_parser = _FIELD_PARSERS.get(key)
+            # The isinstance chain must stay ahead of the table parsers:
+            # table parsers decode the *env-var* representation, which can
+            # differ in meaning from the YAML field (e.g. stall_check's env
+            # form is STALL_CHECK_DISABLE, inverted).  YAML keys are field
+            # names, so typed fields parse by field type.
             if isinstance(current, bool):
                 parsed: Any = _parse_bool(val)
             elif isinstance(current, int):
                 parsed = int(val)
             elif isinstance(current, float):
                 parsed = float(val)
+            elif table_parser is not None:
+                # same validation as the env surface (e.g. platform)
+                parsed = table_parser(val)
             else:
                 parsed = val
             setattr(cfg, key, parsed)

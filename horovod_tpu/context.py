@@ -121,6 +121,11 @@ def init(
         hvd_logging.configure(cfg.log_level, hide_timestamp=cfg.log_hide_timestamp)
         _state.config = cfg
 
+        if cfg.platform:
+            # Must land before any backend initializes; wins over the
+            # image's sitecustomize-pinned platform, unlike the env var.
+            jax.config.update("jax_platforms", cfg.platform)
+
         addr = coordinator_addr or cfg.coordinator_addr
         if addr:
             jax.distributed.initialize(
@@ -132,6 +137,15 @@ def init(
         devs = list(devices) if devices is not None else list(jax.devices())
         if not devs:
             raise RuntimeError("no JAX devices visible")
+        if cfg.platform and devices is None and \
+                devs[0].platform.lower() != cfg.platform.lower():
+            # jax.config.update is a silent no-op once a backend exists
+            # (the script touched jax before init()) — fail fast rather
+            # than start collective engines on the wrong platform.
+            raise RuntimeError(
+                f"requested platform={cfg.platform} but the JAX backend "
+                f"already initialized as {devs[0].platform}; call "
+                "hvd.init() before any other JAX use (or drop --platform)")
         _state.devices = devs
         _state.mesh = Mesh(np.array(devs), axis_names=(cfg.dp_axis_name,))
 

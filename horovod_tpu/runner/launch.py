@@ -78,6 +78,9 @@ def build_parser() -> argparse.ArgumentParser:
                    default=False)
     p.add_argument("--log-level", default=None)
     p.add_argument("--stall-warning-time", type=float, default=None)
+    p.add_argument("--platform", default=None, choices=("tpu", "cpu"),
+                   help="JAX platform workers select at init() "
+                        "(cpu = the dev rig; default: auto)")
     p.add_argument("--verbose", "-v", action="store_true")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="program to run (e.g. python train.py)")
@@ -114,6 +117,8 @@ def _knob_env(args) -> dict:
         env["HVDTPU_LOG_LEVEL"] = args.log_level
     if args.stall_warning_time is not None:
         env["HVDTPU_STALL_CHECK_TIME_SECONDS"] = str(args.stall_warning_time)
+    if args.platform:
+        env["HVDTPU_PLATFORM"] = args.platform
     return env
 
 

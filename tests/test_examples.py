@@ -1,0 +1,49 @@
+"""The stock examples named by BASELINE's config list, run for real via the
+launcher († ``test/integration/test_static_run.py`` runs the reference's
+examples under ``horovodrun`` the same way):
+
+- ResNet-50 ImageNet, torch ``DistributedOptimizer`` data-parallel
+  († ``examples/pytorch/pytorch_imagenet_resnet50.py``)
+- BERT masked-LM pretraining, TF Keras callbacks
+  († BASELINE config "BERT-Large pretraining (TF Keras hvd callback)")
+
+Tiny shapes, 2 real processes, CPU platform (the dev rig).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _hvdrun_example(script_args, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # workers force CPU
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         "--platform", "cpu", "--", sys.executable] + script_args,
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+@pytest.mark.integration
+def test_torch_imagenet_resnet50_example():
+    res = _hvdrun_example(
+        [os.path.join(REPO, "examples", "torch_imagenet_resnet50.py"),
+         "--epochs", "1", "--steps-per-epoch", "1", "--image-size", "32",
+         "--batch-size", "2", "--num-classes", "10",
+         "--batches-per-allreduce", "2"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "DONE resnet50" in res.stdout
+
+
+@pytest.mark.integration
+def test_tf_keras_bert_pretrain_example():
+    res = _hvdrun_example(
+        [os.path.join(REPO, "examples", "tf_keras_bert_pretrain.py"),
+         "--epochs", "1", "--samples", "16", "--batch-size", "8"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "DONE bert" in res.stdout

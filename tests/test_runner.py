@@ -52,6 +52,25 @@ def test_cli_knob_env():
     assert env["HVDTPU_LOG_LEVEL"] == "debug"
 
 
+def test_cli_platform_knob(monkeypatch, tmp_path):
+    args = build_parser().parse_args(
+        ["-np", "2", "--platform", "cpu", "--", "python", "x.py"])
+    assert _knob_env(args)["HVDTPU_PLATFORM"] == "cpu"
+    import horovod_tpu.config as config_mod
+    monkeypatch.setenv("HVDTPU_PLATFORM", "CPU")  # normalized, not passed raw
+    assert config_mod.from_env().platform == "cpu"
+    monkeypatch.setenv("HVDTPU_PLATFORM", "gpu")  # fails at the knob, not jax
+    with pytest.raises(ValueError):
+        config_mod.from_env()
+    monkeypatch.delenv("HVDTPU_PLATFORM")
+    cfgf = tmp_path / "c.yaml"
+    cfgf.write_text("platform: banana\n")
+    with pytest.raises(ValueError):
+        config_mod.from_yaml(str(cfgf))
+    cfgf.write_text("platform: TPU\n")
+    assert config_mod.from_yaml(str(cfgf)).platform == "tpu"
+
+
 def test_cli_config_file(tmp_path):
     cfg = tmp_path / "c.yaml"
     cfg.write_text("cycle_time_ms: 7.5\nautotune: true\n")
