@@ -93,6 +93,8 @@ class KvServer:
 
     @property
     def port(self) -> int:
+        if not self._h:
+            raise RuntimeError("KV server is stopped")
         return self._lib.hvd_kv_server_port(self._h)
 
     def stop(self) -> None:
@@ -172,6 +174,8 @@ class ControllerServer:
 
     @property
     def port(self) -> int:
+        if not self._h:
+            raise RuntimeError("controller server is stopped")
         return self._lib.hvd_ctrl_server_port(self._h)
 
     def stop(self) -> None:

@@ -1,0 +1,131 @@
+"""Shared driver-side plumbing for launchers (ssh fan-out, Spark, Ray).
+
+The reference's Spark and Ray integrations († ``horovod/spark/runner.py``,
+``horovod/ray/runner.py``) both follow the same shape: the driver process
+starts the rendezvous services, builds per-rank environment blocks, and the
+cluster manager (instead of ssh) places the worker processes.  This module
+is that shared shape for the TPU-native runtime: the native KV +
+controller services, the env-block builder, and the placement-exchange
+helpers used by ``runner/launch.py``, ``horovod_tpu/spark`` and
+``horovod_tpu/ray``.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets as _secrets
+import socket
+from typing import Dict, List, Optional
+
+
+def local_ip() -> str:
+    """Routable address other hosts can reach; localhost jobs don't care."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+def pick_coordinator_port() -> int:
+    """Port for the JAX coordinator, which binds on rank 0's host — the
+    driver cannot probe a remote host's free ports, so pick from a wide
+    ephemeral-range slice to make collisions unlikely.  (A conflict fails
+    that worker's startup and the monitor/timeout reports it.)"""
+    import random
+    return random.randint(23000, 29999)
+
+
+class DriverServices:
+    """Native control-plane services bound on the driver.
+
+    Starts the KV rendezvous store and the negotiation controller with a
+    per-job HMAC secret († secret.py: one random credential per job), and
+    hands out the env block each rank needs to ``hvd.init()``.
+    """
+
+    def __init__(self, num_proc: int, *, service_ip: Optional[str] = None,
+                 secret: Optional[str] = None) -> None:
+        from .._native import ControllerServer, KvServer
+
+        if num_proc < 1:
+            raise ValueError(f"num_proc must be >= 1, got {num_proc}")
+        self.num_proc = num_proc
+        self.secret = secret or os.environ.get("HVDTPU_SECRET") \
+            or _secrets.token_hex(16)
+        self.service_ip = service_ip or local_ip()
+        self.kv = KvServer(secret=self.secret)
+        try:
+            self.controller = ControllerServer(size=num_proc,
+                                               secret=self.secret)
+        except Exception:
+            self.kv.stop()  # construction failed; __exit__ will never run
+            raise
+
+    def worker_env(self, rank: int, local_rank: int, *,
+                   coordinator_addr: Optional[str] = None,
+                   platform: Optional[str] = None,
+                   extra_env: Optional[Dict[str, str]] = None
+                   ) -> Dict[str, str]:
+        """The env block ``runner/launch.py base_env`` injects, minus the
+        inherited process env (the cluster manager owns that part)."""
+        env = dict(extra_env or {})
+        env.update({
+            "HVDTPU_CROSS_RANK": str(rank),
+            "HVDTPU_CROSS_SIZE": str(self.num_proc),
+            "HVDTPU_CONTROLLER_ADDR":
+                f"{self.service_ip}:{self.controller.port}",
+            "HVDTPU_RENDEZVOUS_ADDR": f"{self.service_ip}:{self.kv.port}",
+            "HVDTPU_LOCAL_RANK": str(local_rank),
+            "HVDTPU_SECRET": self.secret,
+        })
+        if coordinator_addr:
+            env["HVDTPU_COORDINATOR_ADDR"] = coordinator_addr
+        if platform:
+            env["HVDTPU_PLATFORM"] = platform
+        return env
+
+    def close(self) -> None:
+        self.kv.stop()
+        self.controller.stop()
+
+    def __enter__(self) -> "DriverServices":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def local_ranks(hostnames: List[str]) -> List[int]:
+    """Per-rank local rank, given each rank's hostname in rank order
+    († host_hash.py grouping: ranks sharing a host get 0,1,2,...)."""
+    seen: Dict[str, int] = {}
+    out = []
+    for h in hostnames:
+        out.append(seen.get(h, 0))
+        seen[h] = out[-1] + 1
+    return out
+
+
+# --- placement exchange (worker side) --------------------------------------
+# Each rank contributes placement_info(); from the gathered rank-ordered
+# list, placement_env() derives what only placement can decide: local rank
+# (host grouping) and the JAX coordinator address (rank 0's IP).  Used by
+# the Spark barrier allGather and the Ray placement round.
+
+def placement_info() -> str:
+    return socket.gethostname() + "|" + local_ip()
+
+
+def placement_env(infos: List[str], rank: int, coord_port: int
+                  ) -> Dict[str, str]:
+    hosts = [i.split("|", 1)[0] for i in infos]
+    rank0_ip = infos[0].split("|", 1)[1]
+    return {
+        "HVDTPU_LOCAL_RANK": str(local_ranks(hosts)[rank]),
+        "HVDTPU_COORDINATOR_ADDR": f"{rank0_ip}:{coord_port}",
+    }

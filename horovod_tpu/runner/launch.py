@@ -41,16 +41,7 @@ def _free_port() -> int:
     return port
 
 
-def _local_ip() -> str:
-    # Routable address other hosts can reach; localhost jobs don't care.
-    try:
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        s.connect(("8.8.8.8", 80))
-        ip = s.getsockname()[0]
-        s.close()
-        return ip
-    except OSError:
-        return "127.0.0.1"
+from .cluster import local_ip as _local_ip  # noqa: E402  (shared probe)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -137,7 +128,7 @@ def launch_workers(command: Sequence[str], *, np_total: int,
                    failure_info: Optional[dict] = None) -> int:
     """Start services + workers; wait; return exit code.  Local ranks run as
     child processes, remote ranks through ``ssh`` († gloo_run exec path)."""
-    from .._native import ControllerServer, KvServer
+    from .cluster import DriverServices, pick_coordinator_port
 
     hosts = parse_hosts(hosts_spec) if hosts_spec else \
         parse_hosts(f"localhost:{np_total}")
@@ -160,18 +151,13 @@ def launch_workers(command: Sequence[str], *, np_total: int,
     # setdefault) so an explicitly passed secret wins over a stale one.
     os.environ["HVDTPU_SECRET"] = job_secret
 
-    kv = KvServer(secret=job_secret)
-    ctrl = ControllerServer(size=np_total, secret=job_secret)
+    services = DriverServices(np_total, service_ip=service_ip,
+                              secret=job_secret)
     if is_local_job:
         coord_port = _free_port()
         coord_host = "127.0.0.1"
     else:
-        # The JAX coordinator binds on rank 0's host, which the launcher
-        # cannot probe; pick from a wide ephemeral-range slice to make
-        # collisions unlikely.  (A conflict fails that worker's startup and
-        # the monitor reports it; --start-timeout bounds the wait.)
-        import random
-        coord_port = random.randint(23000, 29999)
+        coord_port = pick_coordinator_port()
         coord_host = assignment[0][1]
 
     workers: List[_Worker] = []
@@ -179,17 +165,13 @@ def launch_workers(command: Sequence[str], *, np_total: int,
     exit_codes: dict[int, int] = {}
 
     def base_env(rank: int, local_rank: int) -> dict:
+        # Full process env (ssh-launched workers inherit the launcher's
+        # environment) + the shared control-plane block.
         env = dict(os.environ)
-        env.update(extra_env or {})
-        env.update({
-            "HVDTPU_COORDINATOR_ADDR": f"{coord_host}:{coord_port}",
-            "HVDTPU_CROSS_RANK": str(rank),
-            "HVDTPU_CROSS_SIZE": str(np_total),
-            "HVDTPU_CONTROLLER_ADDR": f"{service_ip}:{ctrl.port}",
-            "HVDTPU_RENDEZVOUS_ADDR": f"{service_ip}:{kv.port}",
-            "HVDTPU_LOCAL_RANK": str(local_rank),
-            "HVDTPU_SECRET": job_secret,
-        })
+        env.update(services.worker_env(
+            rank, local_rank,
+            coordinator_addr=f"{coord_host}:{coord_port}",
+            extra_env=extra_env))
         return env
 
     def stream(worker: _Worker) -> None:
@@ -275,8 +257,7 @@ def launch_workers(command: Sequence[str], *, np_total: int,
         for w in workers:
             if w.proc.poll() is None:
                 _terminate(w.proc)
-        ctrl.stop()
-        kv.stop()
+        services.close()
 
 
 def _terminate(proc: subprocess.Popen) -> None:

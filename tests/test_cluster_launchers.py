@@ -1,0 +1,318 @@
+"""Spark / Ray launcher integrations, tested against fake cluster managers.
+
+† ``test/single/test_spark.py`` / ``test_ray.py``: upstream tests these by
+mocking the cluster manager's placement primitives and asserting the
+orchestration (env wiring, rank assignment, result collection).  Same here:
+a fake ``pyspark`` whose barrier stage forks one process per partition, and
+a fake ``ray`` whose actors are forked processes — so the env blocks are
+truly per-worker, as on a real cluster.
+"""
+
+import multiprocessing
+import os
+import socket
+import sys
+import types
+
+import pytest
+
+from horovod_tpu.runner.cluster import DriverServices, local_ranks
+
+_mp = multiprocessing.get_context("fork")
+
+
+# ---------------------------------------------------------------------------
+# cluster.py primitives
+# ---------------------------------------------------------------------------
+
+def test_local_ranks():
+    assert local_ranks(["a", "a", "b", "a", "b"]) == [0, 1, 0, 2, 1]
+    assert local_ranks([]) == []
+
+
+def test_driver_services_env():
+    with DriverServices(4, service_ip="127.0.0.1") as s:
+        env = s.worker_env(2, 1, platform="cpu", extra_env={"FOO": "bar"})
+        assert env["HVDTPU_CROSS_RANK"] == "2"
+        assert env["HVDTPU_CROSS_SIZE"] == "4"
+        assert env["HVDTPU_LOCAL_RANK"] == "1"
+        assert env["HVDTPU_PLATFORM"] == "cpu"
+        assert env["FOO"] == "bar"
+        assert env["HVDTPU_SECRET"] == s.secret
+        host, _, port = env["HVDTPU_CONTROLLER_ADDR"].rpartition(":")
+        assert host == "127.0.0.1" and int(port) == s.controller.port
+        assert int(env["HVDTPU_RENDEZVOUS_ADDR"].rpartition(":")[2]) \
+            == s.kv.port
+        kv_port = s.kv.port
+    # close() must actually stop the native servers (regression: a close/
+    # stop naming mismatch silently leaked them); the port must refuse.
+    with pytest.raises(OSError):
+        c = socket.create_connection(("127.0.0.1", kv_port), timeout=2)
+        c.close()
+
+
+def test_driver_services_num_proc_validation():
+    with pytest.raises(ValueError):
+        DriverServices(0)
+
+
+# ---------------------------------------------------------------------------
+# fake pyspark (barrier stage -> forked process per partition)
+# ---------------------------------------------------------------------------
+
+class _FakeBarrierCtx:
+    current = None
+
+    def __init__(self, pid, n, barrier, store):
+        self._pid, self._n, self._barrier, self._store = pid, n, barrier, store
+
+    @classmethod
+    def get(cls):
+        return cls.current
+
+    def partitionId(self):
+        return self._pid
+
+    def allGather(self, s):
+        self._store[self._pid] = s
+        self._barrier.wait()
+        return [self._store[i] for i in range(self._n)]
+
+
+def _install_fake_pyspark(monkeypatch, n_parallel=4):
+    pyspark = types.ModuleType("pyspark")
+    pyspark_sql = types.ModuleType("pyspark.sql")
+    pyspark.BarrierTaskContext = _FakeBarrierCtx
+
+    class _FakeBarrierRDD:
+        def __init__(self, n):
+            self._n = n
+
+        def mapPartitions(self, body):
+            self._body = body
+            return self
+
+        def collect(self):
+            n = self._n
+            mgr = _mp.Manager()
+            store, results = mgr.dict(), mgr.list()
+            barrier = _mp.Barrier(n)
+
+            def child(pid):
+                _FakeBarrierCtx.current = _FakeBarrierCtx(
+                    pid, n, barrier, store)
+                for item in self._body(iter(())):
+                    results.append(item)
+
+            procs = [_mp.Process(target=child, args=(p,)) for p in range(n)]
+            for p in procs:
+                p.start()
+            for p in procs:
+                p.join(60)
+                assert p.exitcode == 0, f"partition failed: {p.exitcode}"
+            return list(results)
+
+    class _FakeRDD:
+        def __init__(self, n):
+            self._n = n
+
+        def barrier(self):
+            return _FakeBarrierRDD(self._n)
+
+    class _FakeConf:
+        def get(self, key, default=None):
+            return default
+
+    class _FakeSparkContext:
+        defaultParallelism = n_parallel
+
+        def getConf(self):
+            return _FakeConf()
+
+        def parallelize(self, data, n):
+            assert len(list(data)) == n
+            return _FakeRDD(n)
+
+    class _FakeSession:
+        sparkContext = _FakeSparkContext()
+
+    class SparkSession:
+        builder = None  # getActiveSession path is the one exercised
+
+        @staticmethod
+        def getActiveSession():
+            return _FakeSession()
+
+    pyspark_sql.SparkSession = SparkSession
+    pyspark.sql = pyspark_sql
+    monkeypatch.setitem(sys.modules, "pyspark", pyspark)
+    monkeypatch.setitem(sys.modules, "pyspark.sql", pyspark_sql)
+
+
+def _env_probe():
+    """The 'training fn': report this rank's wired environment."""
+    return {k: os.environ.get(k, "")
+            for k in ("HVDTPU_CROSS_RANK", "HVDTPU_CROSS_SIZE",
+                      "HVDTPU_LOCAL_RANK", "HVDTPU_SECRET",
+                      "HVDTPU_CONTROLLER_ADDR", "HVDTPU_RENDEZVOUS_ADDR",
+                      "HVDTPU_COORDINATOR_ADDR", "HVDTPU_PLATFORM")}
+
+
+def test_spark_run_wires_ranks(monkeypatch):
+    _install_fake_pyspark(monkeypatch)
+    import horovod_tpu.spark as hvd_spark
+
+    results = hvd_spark.run(_env_probe, num_proc=3, platform="cpu")
+    assert len(results) == 3
+    secrets = {r["HVDTPU_SECRET"] for r in results}
+    assert len(secrets) == 1
+    for rank, r in enumerate(results):
+        assert r["HVDTPU_CROSS_RANK"] == str(rank)
+        assert r["HVDTPU_CROSS_SIZE"] == "3"
+        # all fake partitions run on this host -> local ranks 0,1,2
+        assert r["HVDTPU_LOCAL_RANK"] == str(rank)
+        assert r["HVDTPU_PLATFORM"] == "cpu"
+        assert r["HVDTPU_COORDINATOR_ADDR"].count(":") == 1
+    # every rank got the same controller/rendezvous endpoints
+    assert len({r["HVDTPU_CONTROLLER_ADDR"] for r in results}) == 1
+
+
+def test_spark_run_default_num_proc(monkeypatch):
+    _install_fake_pyspark(monkeypatch, n_parallel=2)
+    import horovod_tpu.spark as hvd_spark
+    results = hvd_spark.run(_env_probe)
+    assert [r["HVDTPU_CROSS_RANK"] for r in results] == ["0", "1"]
+
+
+def test_spark_run_num_proc_validation(monkeypatch):
+    _install_fake_pyspark(monkeypatch)
+    import horovod_tpu.spark as hvd_spark
+    with pytest.raises(ValueError, match="num_proc"):
+        hvd_spark.run(_env_probe, num_proc=0)
+
+
+def test_spark_run_without_pyspark(monkeypatch):
+    monkeypatch.setitem(sys.modules, "pyspark", None)
+    monkeypatch.setitem(sys.modules, "pyspark.sql", None)
+    import horovod_tpu.spark as hvd_spark
+    with pytest.raises(ImportError, match="pyspark"):
+        hvd_spark.run(_env_probe, num_proc=2)
+
+
+# ---------------------------------------------------------------------------
+# fake ray (actor = forked process with a command pipe)
+# ---------------------------------------------------------------------------
+
+class _ActorProc:
+    """Forked process executing (method, args) requests sequentially."""
+
+    def __init__(self, cls, init_args):
+        parent, child = _mp.Pipe()
+        self._pipe = parent
+
+        def loop(conn):
+            obj = cls(*init_args)
+            while True:
+                msg = conn.recv()
+                if msg is None:
+                    return
+                method, args = msg
+                try:
+                    conn.send(("ok", getattr(obj, method)(*args)))
+                except Exception as e:  # pragma: no cover
+                    conn.send(("err", repr(e)))
+
+        self._proc = _mp.Process(target=loop, args=(child,))
+        self._proc.start()
+
+    def call(self, method, args):
+        self._pipe.send((method, args))
+        status, val = self._pipe.recv()
+        assert status == "ok", val
+        return val
+
+    def kill(self):
+        try:
+            self._pipe.send(None)
+        except OSError:
+            pass
+        self._proc.join(10)
+
+
+def _install_fake_ray(monkeypatch):
+    ray = types.ModuleType("ray")
+    ray._initialized = True
+
+    class _Method:
+        def __init__(self, actor, name):
+            self._actor, self._name = actor, name
+
+        def remote(self, *args):
+            return ("ref", self._actor.call(self._name, args))
+
+    class _ActorHandle:
+        def __init__(self, proc):
+            self._proc = proc
+
+        def __getattr__(self, name):
+            return _Method(self._proc, name)
+
+    class _RemoteClass:
+        def __init__(self, cls):
+            self._cls = cls
+            self.opts = {}
+
+        def options(self, **opts):
+            self.opts = opts
+            return self
+
+        def remote(self, *args):
+            return _ActorHandle(_ActorProc(self._cls, args))
+
+    ray.remote = lambda cls: _RemoteClass(cls)
+    ray.is_initialized = lambda: True
+    ray.init = lambda *a, **k: None
+    ray.get = lambda refs: ([r[1] for r in refs]
+                            if isinstance(refs, list) else refs[1])
+    ray.kill = lambda h: h._proc.kill()
+    monkeypatch.setitem(sys.modules, "ray", ray)
+
+
+def test_ray_executor(monkeypatch):
+    _install_fake_ray(monkeypatch)
+    from horovod_tpu.ray import RayExecutor
+
+    ex = RayExecutor(num_workers=3, platform="cpu")
+    ex.start()
+    try:
+        results = ex.run(_env_probe)
+        assert len(results) == 3
+        for rank, r in enumerate(results):
+            assert r["HVDTPU_CROSS_RANK"] == str(rank)
+            assert r["HVDTPU_CROSS_SIZE"] == "3"
+            assert r["HVDTPU_LOCAL_RANK"] == str(rank)  # one fake host
+            assert r["HVDTPU_PLATFORM"] == "cpu"
+        assert len({r["HVDTPU_SECRET"] for r in results}) == 1
+        single = ex.execute_single(_env_probe)
+        assert single["HVDTPU_CROSS_RANK"] == "0"
+    finally:
+        ex.shutdown()
+    assert ex._workers == []
+
+
+def test_ray_executor_errors(monkeypatch):
+    _install_fake_ray(monkeypatch)
+    from horovod_tpu.ray import RayExecutor
+    with pytest.raises(ValueError):
+        RayExecutor(num_workers=0)
+    ex = RayExecutor(num_workers=1)
+    with pytest.raises(RuntimeError, match="start"):
+        ex.run(_env_probe)
+
+
+def test_ray_executor_without_ray(monkeypatch):
+    monkeypatch.setitem(sys.modules, "ray", None)
+    from horovod_tpu.ray import RayExecutor
+    ex = RayExecutor(num_workers=2)
+    with pytest.raises(ImportError, match="ray"):
+        ex.start()

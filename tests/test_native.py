@@ -39,6 +39,19 @@ def test_kv_set_get_roundtrip():
         c.close()
 
 
+def test_server_port_after_stop_raises():
+    # Regression: reading .port after stop() dereferenced the freed native
+    # handle and segfaulted; it must raise instead.
+    srv = KvServer()
+    srv.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        srv.port
+    ctrl = ControllerServer(size=1)
+    ctrl.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        ctrl.port
+
+
 def test_kv_wait_blocks_until_set():
     with KvServer() as srv:
         reader = KvClient("127.0.0.1", srv.port)
