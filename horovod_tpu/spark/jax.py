@@ -1,0 +1,10 @@
+"""JAX estimator under the Spark namespace — the TPU-native analogue of
+† ``horovod.spark.torch`` (upstream's second framework estimator; torch
+users on this framework train eagerly via ``horovod_tpu.torch``, while the
+DataFrame-estimator surface is JAX/Flax-native here).
+"""
+
+from ..estimator import JaxEstimator, JaxModel
+from ..estimator.store import LocalStore
+
+__all__ = ["JaxEstimator", "JaxModel", "LocalStore"]

@@ -1,0 +1,10 @@
+"""† ``horovod.spark.keras``: upstream users import the Keras estimator as
+``from horovod.spark.keras import KerasEstimator``.  The TPU-native
+estimator implementation lives in ``horovod_tpu/estimator``; this module is
+the upstream-shaped import path for it.
+"""
+
+from ..estimator import KerasEstimator, KerasModel
+from ..estimator.store import LocalStore
+
+__all__ = ["KerasEstimator", "KerasModel", "LocalStore"]

@@ -271,4 +271,8 @@ def __getattr__(name: str):
         # elastic machinery isn't paid for by collective-only users.
         import importlib
         return importlib.import_module("horovod_tpu.torch.elastic")
+    if name == "SyncBatchNorm":
+        # † ``hvd.SyncBatchNorm`` — lazy: it imports this module back.
+        from .sync_batch_norm import SyncBatchNorm
+        return SyncBatchNorm
     raise AttributeError(f"module 'horovod_tpu.torch' has no attribute {name!r}")

@@ -1,0 +1,76 @@
+"""Worker for the multi-process SyncBatchNorm test.
+
+Two ranks, DIFFERENT data shards.  SyncBatchNorm's output and input
+gradient on each shard must equal stock BatchNorm run over the
+CONCATENATED global batch († sync_batch_norm.py semantics: global batch
+statistics), which each rank reconstructs locally as the oracle.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=1"
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+def main() -> int:
+    hvd.init()
+    me, n = hvd.cross_rank(), hvd.size()
+    assert n == 2, n
+    torch.manual_seed(7)  # same on both ranks
+    # UNEVEN per-rank batches (3 vs 5): the summed-count design must keep
+    # statistics and the unbiased running_var correction exact.
+    sizes = [3, 5]
+    shards = [torch.randn(s, 2, 4, 4) for s in sizes]
+
+    # --- distributed: my shard through SyncBatchNorm ---
+    sbn = hvd.SyncBatchNorm(2)
+    with torch.no_grad():
+        sbn.weight.copy_(torch.tensor([1.5, 0.5]))
+        sbn.bias.copy_(torch.tensor([0.1, -0.2]))
+    x = shards[me].clone().requires_grad_(True)
+    y = sbn(x)
+    y.square().sum().backward()
+
+    # --- oracle: stock BN over the concatenated batch ---
+    bn = torch.nn.BatchNorm2d(2)
+    bn.load_state_dict({k: v.clone() if v.dtype.is_floating_point else v
+                        for k, v in sbn.state_dict().items()},
+                       strict=False)
+    with torch.no_grad():
+        bn.weight.copy_(torch.tensor([1.5, 0.5]))
+        bn.bias.copy_(torch.tensor([0.1, -0.2]))
+        bn.running_mean.zero_()
+        bn.running_var.fill_(1.0)
+    xg = torch.cat(shards).clone().requires_grad_(True)
+    yg = bn(xg)
+    yg.square().sum().backward()
+
+    off = sum(sizes[:me])
+    my = slice(off, off + sizes[me])
+    assert torch.allclose(y, yg[my], atol=1e-5), \
+        (y - yg[my]).abs().max().item()
+    assert torch.allclose(x.grad, xg.grad[my], atol=1e-4), \
+        (x.grad - xg.grad[my]).abs().max().item()
+    # weight/bias grads are LOCAL sums; averaged across ranks they must
+    # equal the oracle's grad / n (the DistributedOptimizer convention).
+    wg = hvd.allreduce(sbn.weight.grad.clone(), op=hvd.Average,
+                       name="wg_check")
+    assert torch.allclose(wg, bn.weight.grad / n, atol=1e-4)
+    # running stats synced to global statistics on every rank (same global
+    # count -> same unbiased correction as the oracle)
+    assert torch.allclose(sbn.running_mean, bn.running_mean, atol=1e-5)
+    assert torch.allclose(sbn.running_var, bn.running_var, atol=1e-5)
+    print(f"rank {me}: SYNC-BN-OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

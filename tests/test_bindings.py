@@ -176,3 +176,76 @@ def test_keras_schedule_callback():
     lr2 = float(np.asarray(model.optimizer.learning_rate))
     assert lr0 == pytest.approx(0.1)
     assert lr2 == pytest.approx(0.001)
+
+
+# ---------------------------------------------------------------------------
+# SyncBatchNorm († horovod/torch/sync_batch_norm.py)
+# ---------------------------------------------------------------------------
+
+def test_torch_sync_batch_norm_matches_local_bn():
+    """In-process rig: every 'rank' sees identical data, so global batch
+    statistics equal local ones — SyncBatchNorm must reproduce stock
+    BatchNorm exactly, forward and backward."""
+    torch.manual_seed(0)
+    x = torch.randn(4, 3, 5, 5)
+
+    sbn = hvd_torch.SyncBatchNorm(3)
+    bn = torch.nn.BatchNorm2d(3)
+    bn.load_state_dict({k: v.clone() for k, v in sbn.state_dict().items()})
+
+    xs = x.clone().requires_grad_(True)
+    xb = x.clone().requires_grad_(True)
+    ys, yb = sbn(xs), bn(xb)
+    assert torch.allclose(ys, yb, atol=1e-5), (ys - yb).abs().max()
+    ys.square().sum().backward()
+    yb.square().sum().backward()
+    assert torch.allclose(xs.grad, xb.grad, atol=1e-4)
+    assert torch.allclose(sbn.weight.grad, bn.weight.grad, atol=1e-4)
+    assert torch.allclose(sbn.bias.grad, bn.bias.grad, atol=1e-4)
+    assert torch.allclose(sbn.running_mean, bn.running_mean, atol=1e-5)
+    # running_var's unbiased correction uses the GLOBAL count (8 fake ranks
+    # x 100 samples here), not the local 100 — distributed semantics.
+    n = 4 * 5 * 5 * hvd.size()
+    biased = x.var([0, 2, 3], unbiased=False)
+    expect = 0.9 * torch.ones(3) + 0.1 * biased * n / (n - 1)
+    assert torch.allclose(sbn.running_var, expect, atol=1e-5)
+
+
+def test_torch_sync_batch_norm_eval_fallback():
+    sbn = hvd_torch.SyncBatchNorm(4)
+    sbn.eval()
+    x = torch.randn(2, 4)
+    # eval path = stock kernel on running stats (zeros mean/ones var)
+    assert torch.allclose(sbn(x), x, atol=1e-5)
+
+
+def test_torch_sync_batch_norm_bad_dim():
+    sbn = hvd_torch.SyncBatchNorm(4)
+    with pytest.raises(ValueError):
+        sbn(torch.randn(4))
+
+
+def test_torch_sync_batch_norm_momentum_none():
+    """momentum=None = cumulative moving average, like stock BatchNorm
+    (regression: the fallback crashed and the sync path used 0.1)."""
+    torch.manual_seed(1)
+    sbn = hvd_torch.SyncBatchNorm(3, momentum=None)
+    bn = torch.nn.BatchNorm2d(3, momentum=None)
+    for _ in range(3):
+        x = torch.randn(4, 3, 5, 5)
+        sbn(x), bn(x)
+    assert torch.allclose(sbn.running_mean, bn.running_mean, atol=1e-5)
+    assert sbn.num_batches_tracked == bn.num_batches_tracked == 3
+    sbn.eval()
+    sbn(torch.randn(2, 3, 5, 5))  # eval fallback must not crash
+
+
+def test_torch_sync_batch_norm_no_running_stats():
+    """track_running_stats=False: always batch statistics, eval included
+    (regression: eval crashed on running_mean=None)."""
+    sbn = hvd_torch.SyncBatchNorm(3, track_running_stats=False)
+    bn = torch.nn.BatchNorm2d(3, track_running_stats=False)
+    x = torch.randn(4, 3, 5, 5)
+    assert torch.allclose(sbn(x), bn(x), atol=1e-5)
+    sbn.eval(), bn.eval()
+    assert torch.allclose(sbn(x), bn(x), atol=1e-5)

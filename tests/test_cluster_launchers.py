@@ -310,6 +310,14 @@ def test_ray_executor_errors(monkeypatch):
         ex.run(_env_probe)
 
 
+def test_spark_estimator_namespaces():
+    """† horovod.spark.keras import path shape."""
+    from horovod_tpu.spark.keras import KerasEstimator, LocalStore  # noqa
+    from horovod_tpu.spark.jax import JaxEstimator  # noqa
+    from horovod_tpu.estimator import KerasEstimator as KE
+    assert KerasEstimator is KE
+
+
 def test_ray_executor_without_ray(monkeypatch):
     monkeypatch.setitem(sys.modules, "ray", None)
     from horovod_tpu.ray import RayExecutor

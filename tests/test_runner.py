@@ -121,6 +121,16 @@ def test_hvdrun_no_command():
 
 
 @pytest.mark.integration
+def test_hvdrun_sync_batch_norm():
+    """† sync_batch_norm semantics over 2 real processes with different
+    shards, against a concatenated-batch BatchNorm oracle."""
+    res = _hvdrun(2, [os.path.join(REPO, "tests", "mp_sync_bn_worker.py")])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "rank 0: SYNC-BN-OK" in res.stdout
+    assert "rank 1: SYNC-BN-OK" in res.stdout
+
+
+@pytest.mark.integration
 def test_hvdrun_torch_distributed_optimizer():
     """†3.2: the torch hot path over 2 real processes with different data."""
     res = _hvdrun(2, [os.path.join(REPO, "tests", "mp_torch_worker.py")])
