@@ -49,8 +49,11 @@ def build_parser() -> argparse.ArgumentParser:
         prog="hvdrun",
         description="Launch a horovod_tpu job "
                     "(reference parity: horovodrun)")
-    p.add_argument("-np", "--num-proc", type=int, required=True,
+    p.add_argument("-np", "--num-proc", type=int, default=None,
                    help="total number of processes")
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   help="print build capabilities and exit "
+                        "(† horovodrun --check-build)")
     p.add_argument("-H", "--hosts", default=None,
                    help="host1:slots,host2:slots (default: localhost:np)")
     p.add_argument("--ssh-port", type=int, default=22)
@@ -281,13 +284,49 @@ def run(command: Sequence[str], np: int, *, hosts: Optional[str] = None,
                           extra_env=env, verbose=verbose)
 
 
+def _check_build() -> int:
+    """† ``horovodrun --check-build``: print what this build supports."""
+    import horovod_tpu as hvd
+
+    def have(mod: str) -> bool:
+        import importlib.util
+        return importlib.util.find_spec(mod) is not None
+
+    def mark(flag: bool) -> str:
+        return "[X]" if flag else "[ ]"
+
+    print("horovod_tpu:\n")
+    print("Available Frameworks:")
+    print(f"    {mark(True)} JAX / Flax")
+    print(f"    {mark(have('tensorflow'))} TensorFlow / Keras")
+    print(f"    {mark(have('torch'))} PyTorch")
+    print("\nAvailable Controllers:")
+    print(f"    {mark(hvd.native_built())} native (C++ KV + coordinator)")
+    print(f"    {mark(True)} JAX coordination service")
+    print("\nAvailable Tensor Operations:")
+    print(f"    {mark(hvd.xla_built())} XLA collectives (ICI/DCN on TPU)")
+    print(f"    {mark(True)} CPU (host-platform devices)")
+    print(f"    {mark(hvd.nccl_built() > 0)} NCCL")
+    print(f"    {mark(hvd.mpi_built())} MPI")
+    print(f"    {mark(hvd.gloo_built())} Gloo-role rendezvous")
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.check_build:
+        try:
+            return _check_build()
+        except BrokenPipeError:  # e.g. piped into `head`
+            return 0
     command = list(args.command)
     if command and command[0] == "--":
         command = command[1:]
     if not command:
         print("hvdrun: no command given", file=sys.stderr)
+        return 2
+    if args.num_proc is None or args.num_proc < 1:
+        print("hvdrun: -np/--num-proc (>= 1) is required", file=sys.stderr)
         return 2
     extra_env = _knob_env(args)
     return launch_workers(command, np_total=args.num_proc,

@@ -84,19 +84,72 @@ def alltoall(tensor: torch.Tensor, splits=None,
     return _from_result(_hvd.alltoall(_to_per_rank(tensor), splits), tensor)
 
 
-# -- async verbs († *_async_ + HandleManager) --
+# -- in-place variants († ``hvd.allreduce_`` / ``hvd.broadcast_``: the
+# torch API's underscore convention writes the result back into the given
+# tensor; same collectives underneath) --
+
+def allreduce_(tensor: torch.Tensor, op: ReduceOp = Average,
+               name: Optional[str] = None) -> torch.Tensor:
+    with torch.no_grad():
+        tensor.copy_(allreduce(tensor, op, name))
+    return tensor
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: Optional[str] = None) -> torch.Tensor:
+    with torch.no_grad():
+        tensor.copy_(broadcast(tensor, root_rank, name))
+    return tensor
+
+
+# -- async verbs († *_async / *_async_ + HandleManager) --
+
+class _InplaceHandle:
+    """Async handle whose synchronize() writes back into the source
+    tensor († the ``*_async_`` in-place convention)."""
+
+    def __init__(self, handle, target: torch.Tensor) -> None:
+        self.handle = handle
+        self.target = target
+
 
 def allreduce_async(tensor: torch.Tensor, op: ReduceOp = Average,
                     name: Optional[str] = None):
     return _hvd.allreduce_async(_to_per_rank(tensor), op, name=name)
 
 
+def allreduce_async_(tensor: torch.Tensor, op: ReduceOp = Average,
+                     name: Optional[str] = None) -> _InplaceHandle:
+    return _InplaceHandle(allreduce_async(tensor, op, name), tensor)
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None):
+    return _hvd.broadcast_async(_to_per_rank(tensor), root_rank, name=name)
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: Optional[str] = None) -> _InplaceHandle:
+    return _InplaceHandle(broadcast_async(tensor, root_rank, name), tensor)
+
+
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None):
+    return _hvd.allgather_async(_to_per_rank(tensor), name=name)
+
+
 def synchronize(handle) -> torch.Tensor:
+    if isinstance(handle, _InplaceHandle):
+        result = synchronize(handle.handle)
+        with torch.no_grad():
+            handle.target.copy_(result)
+        return handle.target
     result = _hvd.synchronize(handle)
     return torch.from_numpy(np.array(_hvd.to_numpy(result)))
 
 
 def poll(handle) -> bool:
+    if isinstance(handle, _InplaceHandle):
+        handle = handle.handle
     return _hvd.poll(handle)
 
 

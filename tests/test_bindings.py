@@ -249,3 +249,30 @@ def test_torch_sync_batch_norm_no_running_stats():
     assert torch.allclose(sbn(x), bn(x), atol=1e-5)
     sbn.eval(), bn.eval()
     assert torch.allclose(sbn(x), bn(x), atol=1e-5)
+
+
+def test_torch_inplace_variants():
+    """† hvd.allreduce_ / broadcast_ / *_async_ write back into the given
+    tensor (torch underscore convention)."""
+    t = torch.full((4,), 2.0)
+    out = hvd_torch.allreduce_(t, op=hvd_torch.Average, name="inp_ar")
+    assert out is t and torch.allclose(t, torch.full((4,), 2.0))
+
+    t = torch.full((3,), float(hvd.rank() + 5))
+    out = hvd_torch.broadcast_(t, root_rank=0, name="inp_bc")
+    assert out is t and torch.allclose(t, torch.full((3,), 5.0))
+
+    t = torch.full((2,), 3.0)
+    h = hvd_torch.allreduce_async_(t, name="inp_ar_async")
+    res = hvd_torch.synchronize(h)
+    assert res is t and torch.allclose(t, torch.full((2,), 3.0))
+    assert hvd_torch.poll(h) in (True, False)
+
+    t = torch.full((2,), float(hvd.rank() + 7))
+    h = hvd_torch.broadcast_async_(t, root_rank=0, name="inp_bc_async")
+    assert hvd_torch.synchronize(h) is t
+    assert torch.allclose(t, torch.full((2,), 7.0))
+
+    g = hvd_torch.synchronize(
+        hvd_torch.allgather_async(torch.ones(2), name="inp_ag"))
+    assert g.shape[0] == 2 * hvd.size()

@@ -137,3 +137,24 @@ def test_hvdrun_torch_distributed_optimizer():
     assert res.returncode == 0, res.stdout + res.stderr
     assert "rank 0: TORCH-OK" in res.stdout
     assert "rank 1: TORCH-OK" in res.stdout
+
+
+@pytest.mark.integration
+def test_hvdrun_check_build():
+    """† horovodrun --check-build prints capabilities without launching."""
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "--check-build"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "Available Frameworks" in res.stdout
+    assert "[X] JAX / Flax" in res.stdout
+    assert "Available Tensor Operations" in res.stdout
+
+
+@pytest.mark.integration
+def test_hvdrun_missing_np():
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "--", "python", "x.py"],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert res.returncode == 2
+    assert "num-proc" in res.stderr
