@@ -1,39 +1,85 @@
 """Benchmark harness: prints ONE JSON line for the driver.
 
 Measures flagship (Llama-family) training-step throughput in tokens/sec on
-the available hardware.  ``vs_baseline`` compares against the recorded
-baseline for the same platform in ``BENCH_BASELINE`` below (first-round
-value measured on this repo's TPU v5-lite dev chip; the reference's own
-published numbers are GPU-cluster scaling efficiencies — see BASELINE.md —
-with no single-chip figure to compare against, so the stored first
-measurement is the regression anchor).
+the available hardware, plus MFU against the chip's peak bf16 FLOPs and an
+allreduce bus-bandwidth point from ``benchmarks.collective_bench``.
+
+Resilience design (round-2, after BENCH_r01 failed with a raw traceback):
+the orchestrating process NEVER imports jax.  The image's sitecustomize
+pins an ``axon`` TPU platform whose initialization can *hang* (not just
+raise) when the tunnel is down, so all measurement happens in worker
+subprocesses guarded by timeouts:
+
+    python bench.py                # orchestrator: probe TPU -> measure
+    python bench.py --worker tpu   # (internal) measure on default backend
+    python bench.py --worker cpu   # (internal) measure on forced-CPU
+
+If the TPU cannot be probed within BENCH_TPU_PROBE_TIMEOUT (2 attempts),
+the orchestrator falls back to CPU and the emitted JSON says so via
+``tpu_unavailable: true`` — a diagnostic result, never a stack trace.
+
+``vs_baseline`` compares against ``BENCH_BASELINE`` below.  The reference's
+published numbers are GPU-cluster scaling efficiencies (BASELINE.md) with
+no single-chip figure, so the anchor is this repo's own first TPU
+measurement; every successful TPU run appends its record to
+``benchmarks/measured.jsonl`` so the anchor is backed by committed data.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+REPO = os.path.dirname(os.path.abspath(__file__))
 
-# tokens/sec anchors per platform (measured at round 1 on TPU v5-lite).
+# tokens/sec/chip anchors per platform.  The tpu figure is the round-1
+# measurement on the dev v5-lite chip (provisional until a run appends a
+# confirming record to benchmarks/measured.jsonl).
 BENCH_BASELINE = {
     "tpu": 57800.0,
-    "cpu": 2000.0,
+    "cpu": 9200.0,
 }
 
+# Peak bf16 matmul FLOPs/s per chip by device-kind substring (public specs).
+PEAK_FLOPS = [
+    ("v6", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
-def main() -> None:
+
+def _peak_flops(device_kind: str) -> float:
+    kind = device_kind.lower()
+    for sub, peak in PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return 197e12  # conservative default: v5-lite class
+
+
+def worker(platform: str) -> None:
+    """Measure on this process's backend and print one JSON line."""
+    if platform == "cpu":
+        from horovod_tpu.utils.cpurig import force_cpu_platform
+        force_cpu_platform(1)
     import jax
     import jax.numpy as jnp
     import optax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from horovod_tpu.models import llama
     from horovod_tpu.parallel import MeshConfig, build_mesh
 
     backend = jax.default_backend()
-    n_dev = len(jax.devices())
+    devices = jax.devices()
+    n_dev = len(devices)
+    device_kind = getattr(devices[0], "device_kind", backend)
 
     if backend == "tpu":
         cfg = llama.LlamaConfig(
@@ -49,10 +95,12 @@ def main() -> None:
 
     mesh = build_mesh(MeshConfig(dp=n_dev))
     params = llama.init_params(cfg, jax.random.PRNGKey(0), mesh)
+    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
     tx = optax.adam(1e-4)
     opt_state = jax.jit(tx.init)(params)
     step = llama.make_train_step(cfg, mesh, tx)
 
+    import numpy as np
     tokens = np.random.RandomState(0).randint(
         0, cfg.vocab_size, size=(B * n_dev, S + 1))
     batch = jax.device_put({"tokens": jnp.asarray(tokens, jnp.int32)},
@@ -72,13 +120,123 @@ def main() -> None:
 
     tokens_per_sec = B * n_dev * S * steps / elapsed
     per_chip = tokens_per_sec / n_dev
+
+    # Training FLOPs/token: 6*N for the dense params (+backward), plus the
+    # attention score/value matmuls 12*L*d_model*S (PaLM-appendix counting).
+    flops_per_token = 6.0 * n_params + 12.0 * cfg.n_layers * cfg.d_model * S
+    mfu = (per_chip * flops_per_token) / _peak_flops(device_kind)
+
+    # Allreduce bus-bandwidth point on the same mesh (16 MB payload).
+    busbw = None
+    try:
+        import horovod_tpu as hvd
+        from benchmarks.collective_bench import allreduce_busbw
+        hvd.init()
+        pt = allreduce_busbw(1 << 24, iters=10, warmup=2)
+        busbw = {"busbw_GBs": round(pt["busbw_GBs"], 2),
+                 "at_bytes": pt["bytes"], "ranks": pt["ranks"]}
+    except Exception as e:  # busbw is auxiliary; never sink the main metric
+        print(f"busbw point failed: {e!r}", file=sys.stderr)
+
     base = BENCH_BASELINE.get(backend, per_chip)
-    print(json.dumps({
+    record = {
         "metric": f"llama_train_tokens_per_sec_per_chip_{backend}",
         "value": round(per_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(per_chip / base, 3),
-    }))
+        "mfu": round(mfu, 4),
+        "device_kind": device_kind,
+        "n_devices": n_dev,
+        "allreduce_busbw": busbw,
+    }
+    if backend == "tpu":
+        # Persist the raw measurement so the anchor is backed by data.
+        try:
+            with open(os.path.join(REPO, "benchmarks", "measured.jsonl"),
+                      "a") as f:
+                f.write(json.dumps({**record, "ts": time.time(),
+                                    "loss": final_loss}) + "\n")
+        except OSError as e:
+            print(f"could not persist measurement: {e!r}", file=sys.stderr)
+    print(json.dumps(record))
+
+
+def _run_worker(platform: str, timeout: float):
+    """Run a measurement worker; return (parsed_json | None, diagnostic)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker", platform]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return None, f"{platform} worker timed out after {timeout:.0f}s"
+    if r.returncode != 0:
+        tail = (r.stderr or r.stdout or "").strip().splitlines()[-3:]
+        return None, f"{platform} worker rc={r.returncode}: {' | '.join(tail)}"
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line), None
+        except json.JSONDecodeError:
+            continue
+    return None, f"{platform} worker produced no JSON"
+
+
+def probe_tpu(timeout: float) -> tuple[bool, str]:
+    """Can a subprocess see the TPU at all (init may hang, hence timeout)?"""
+    code = ("import jax; ds = jax.devices(); "
+            "print(ds[0].platform, len(ds))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False, f"device probe hung >{timeout:.0f}s (tunnel down?)"
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-1:]
+        return False, f"device probe rc={r.returncode}: {''.join(tail)}"
+    if "tpu" not in r.stdout.lower():
+        return False, f"no TPU in probe output: {r.stdout.strip()!r}"
+    return True, r.stdout.strip()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", choices=["tpu", "cpu"])
+    args = ap.parse_args()
+    if args.worker:
+        worker(args.worker)
+        return
+
+    probe_timeout = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "90"))
+    bench_timeout = float(os.environ.get("BENCH_TIMEOUT", "900"))
+
+    diags = []
+    ok = False
+    for attempt in range(2):
+        ok, diag = probe_tpu(probe_timeout)
+        if ok:
+            break
+        diags.append(f"probe#{attempt + 1}: {diag}")
+        time.sleep(5)
+
+    if ok:
+        result, diag = _run_worker("tpu", bench_timeout)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        diags.append(diag)
+
+    # CPU fallback: still produce a parseable, honest line.
+    result, diag = _run_worker("cpu", bench_timeout)
+    if result is None:
+        diags.append(diag)
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "error": "; ".join(d for d in diags if d),
+        }))
+        return
+    result["tpu_unavailable"] = True
+    result["tpu_diagnostic"] = "; ".join(d for d in diags if d)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -110,8 +110,11 @@ def main() -> None:
     callbacks = [
         hvd.callbacks.BroadcastGlobalVariablesCallback(0),
         hvd.callbacks.MetricAverageCallback(),
+        # Ramp base_lr -> base_lr*size: the callback multiplies initial_lr
+        # by hvd.size() at the end of warmup, so passing scaled_lr here
+        # would double-scale to base_lr*size^2.
         hvd.callbacks.LearningRateWarmupCallback(
-            initial_lr=scaled_lr, warmup_epochs=1, steps_per_epoch=steps),
+            initial_lr=args.base_lr, warmup_epochs=1, steps_per_epoch=steps),
     ]
     model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs,
               callbacks=callbacks,

@@ -396,15 +396,25 @@ def global_process_set():
 # join() — uneven-input termination
 # ---------------------------------------------------------------------------
 
-def join(rank_done: Optional[int] = None) -> int:
+def join(timeout: Optional[float] = None) -> int:
     """Signal this rank has no more input († ``hvd.join()``,
-    ``RequestType::JOIN``: a joined rank participates as zero tensors until
-    all ranks join; returns the last rank to join).
+    ``RequestType::JOIN``).  Returns the last rank to join.
 
-    Single-controller form: callers pass ``rank_done`` per logical rank via
-    the higher-level ``JoinBarrier`` in :mod:`horovod_tpu.elastic`; bare
-    ``join()`` drains outstanding work and returns ``size()-1``.
+    Multi-process mode: the joined rank keeps participating in other ranks'
+    negotiated collectives as zero tensors until every rank joins — uneven
+    per-rank input sizes terminate cleanly instead of deadlocking.  As in
+    the reference, ``Average`` divides by the full world size including
+    joined (zero-contributing) ranks.
+
+    Single-controller mode drains outstanding work (one process holds every
+    rank's data, so inputs cannot be uneven across ranks) and returns
+    ``size()-1``.
     """
+    state = global_state()
+    if not state.initialized or state.engine is None:
+        raise NotInitializedError()
+    if state.engine.distributed:
+        return state.engine.join(timeout=timeout)
     barrier()
     return size() - 1
 

@@ -11,7 +11,23 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import NamedTuple, Optional
+
+
+class NegotiationResult(NamedTuple):
+    """One negotiation round's outcome († ``Response`` list).
+
+    ``ready``: globally-ready tensor names in the agreed fuse order.
+    ``stalled``: names some ranks submitted but others haven't (stall warn).
+    ``metas``: name → opaque descriptor for ready tensors (used by joined
+    ranks to build zero-payload participation).
+    ``all_joined`` / ``last_join_rank``: † ``hvd.join()`` completion signal.
+    """
+    ready: list
+    stalled: list
+    metas: dict
+    all_joined: bool
+    last_join_rank: int
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -72,8 +88,10 @@ def load() -> ctypes.CDLL:
                                          ctypes.c_int, ctypes.c_int,
                                          ctypes.c_char_p]
         lib.hvd_ctrl_negotiate.restype = ctypes.c_int
-        lib.hvd_ctrl_negotiate.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                           ctypes.c_char_p, ctypes.c_int]
+        lib.hvd_ctrl_negotiate.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
         lib.hvd_ctrl_cache_size.restype = ctypes.c_int
         lib.hvd_ctrl_cache_size.argtypes = [ctypes.c_void_p]
         lib.hvd_ctrl_close.argtypes = [ctypes.c_void_p]
@@ -203,16 +221,30 @@ class ControllerClient:
             raise ConnectionError(
                 f"cannot reach controller {host}:{port} (rank {rank})")
 
-    def negotiate(self, names: list[str], timeout_ms: int = 60000
-                  ) -> tuple[list[str], list[str]]:
-        """Submit newly-ready tensor names; block until the round completes.
+    def negotiate(self, names, joined: bool = False,
+                  timeout_ms: int = 60000) -> "NegotiationResult":
+        """Submit pending tensors; block until the round completes.
 
-        Returns (globally_ready_ordered, stalled_warnings).
+        ``names``: list of tensor names, or (name, meta) pairs — ``meta`` is
+        an opaque descriptor (travels once per tensor; the coordinator echoes
+        it on ready tensors so joined ranks can build zero participation).
+        ``joined``: this rank has no more inputs († RequestType::JOIN).
         """
-        blob = "\n".join(names).encode()
+        items = []
+        for it in names:
+            if isinstance(it, str):
+                items.append(it)
+            else:
+                name, meta = it
+                items.append(f"{name}\x02{meta}" if meta else name)
+        blob = "\n".join(items).encode()
         cap = 1 << 20  # 1 MB of tensor names per round is far beyond real use
         buf = ctypes.create_string_buffer(cap)
-        n = self._lib.hvd_ctrl_negotiate(self._h, blob, buf, cap)
+        all_joined = ctypes.c_int(0)
+        last_rank = ctypes.c_int(0)
+        n = self._lib.hvd_ctrl_negotiate(
+            self._h, blob, 1 if joined else 0, buf, cap,
+            ctypes.byref(all_joined), ctypes.byref(last_rank))
         if n < 0:
             raise ConnectionError("negotiation failed (controller gone?)")
         if n > cap:
@@ -220,9 +252,17 @@ class ControllerClient:
             raise RuntimeError(f"negotiation response {n} bytes exceeds cap")
         payload = buf.raw[:n].decode()
         ready_part, _, stalled_part = payload.partition("\x01")
-        ready = [s for s in ready_part.split("\n") if s]
+        ready, metas = [], {}
+        for item in ready_part.split("\n"):
+            if not item:
+                continue
+            name, _, meta = item.partition("\x02")
+            ready.append(name)
+            if meta:
+                metas[name] = meta
         stalled = [s for s in stalled_part.split("\n") if s]
-        return ready, stalled
+        return NegotiationResult(ready, stalled, metas,
+                                 bool(all_joined.value), last_rank.value)
 
     @property
     def cache_size(self) -> int:

@@ -30,6 +30,7 @@ blocking latency ≈ dispatch cost while concurrent async traffic still fuses.
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from dataclasses import dataclass, field
@@ -61,6 +62,31 @@ class TensorTableEntry:
     postscale: float = 1.0
     process_set: Any = None
     enqueue_time: float = field(default_factory=time.monotonic)
+
+    def meta(self) -> str:
+        """Serialized descriptor carried through negotiation so a joined
+        rank can construct zero-payload participation († the Response's
+        tensor metadata that backs ``RequestType::JOIN``).  Empty for
+        entries a joined rank cannot rebuild (process-set sub-meshes,
+        ragged list payloads)."""
+        if self.process_set is not None:
+            return ""
+        p = self.payload
+        try:
+            shape, dtype = tuple(p.shape), str(p.dtype)
+        except AttributeError:
+            return ""
+        m: dict = {"v": self.verb, "d": dtype, "s": list(shape),
+                   "o": self.op.value}
+        if self.root_rank:
+            m["r"] = self.root_rank
+        if self.splits is not None:
+            m["sp"] = list(self.splits)
+        if self.prescale != 1.0:
+            m["ps"] = self.prescale
+        if self.postscale != 1.0:
+            m["po"] = self.postscale
+        return json.dumps(m, separators=(",", ":"))
 
 
 class Handle:
@@ -96,6 +122,22 @@ class Handle:
         return self._result
 
 
+@dataclass
+class NegotiationOutcome:
+    """One round's agreed result († ``Response`` list).
+
+    ``ready``: globally-ready names in the agreed dispatch order.
+    ``metas``: name → serialized entry descriptor for ready tensors this
+    process may not hold locally (join zero-participation).
+    ``all_joined`` / ``last_join_rank``: † ``hvd.join()`` completion.
+    """
+    ready: list[str]
+    stalled: list[str] = field(default_factory=list)
+    metas: dict = field(default_factory=dict)
+    all_joined: bool = False
+    last_join_rank: int = 0
+
+
 class Negotiator:
     """Readiness protocol interface († ``Controller::ComputeResponseList``)."""
 
@@ -104,9 +146,9 @@ class Negotiator:
     # list each cycle, possibly empty).
     always_check_in = False
 
-    def negotiate(self, entries: list[TensorTableEntry]
-                  ) -> list[TensorTableEntry]:
-        """Return the subset (in agreed order) to execute this cycle."""
+    def negotiate(self, entries: list[TensorTableEntry], *,
+                  joined: bool = False) -> NegotiationOutcome:
+        """Return the agreed ready set (ordered) for this cycle."""
         raise NotImplementedError
 
     def close(self) -> None:
@@ -116,9 +158,9 @@ class Negotiator:
 class SingleControllerNegotiator(Negotiator):
     """One process sees every request — everything is ready immediately."""
 
-    def negotiate(self, entries: list[TensorTableEntry]
-                  ) -> list[TensorTableEntry]:
-        return entries
+    def negotiate(self, entries: list[TensorTableEntry], *,
+                  joined: bool = False) -> NegotiationOutcome:
+        return NegotiationOutcome(ready=[e.name for e in entries])
 
 
 class CollectiveEngine:
@@ -143,6 +185,9 @@ class CollectiveEngine:
         self._cycle_count = 0
         self._last_stall_warn = 0.0
         self._autotuner = None  # attached lazily when autotune is enabled
+        self._join_requested = False
+        self._join_result = -1
+        self._join_event = threading.Event()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -256,8 +301,9 @@ class CollectiveEngine:
         t0 = time.monotonic()
         entries = [e for e, _ in batch]
         handles = {id(e): h for e, h in batch}
+        join_req = self._join_requested
         try:
-            ready = self._negotiator.negotiate(entries)
+            outcome = self._negotiator.negotiate(entries, joined=join_req)
         except Exception as err:
             # Negotiation transport failure (controller died, TCP error):
             # fail every handle in the batch so waiters raise instead of
@@ -267,9 +313,38 @@ class CollectiveEngine:
                 with self._lock:
                     self._names_pending.discard(e.name)
                 h._complete(error=err)
+            if join_req:
+                with self._lock:
+                    self._join_requested = False
+                    self._join_result = -1
+                self._join_event.set()
             log.error("negotiation failed; %d collectives errored: %s",
                       len(batch), err)
             return
+        by_name = {e.name: e for e in entries}
+        ready: list[TensorTableEntry] = []
+        for name in outcome.ready:
+            e = by_name.get(name)
+            if e is None and join_req:
+                # Not ours: another rank's tensor became ready because we
+                # joined — participate with zeros († JoinOp).  If zeros
+                # cannot be built (no/unusable metadata), fail the join
+                # loudly: the alternative is a silent mesh-wide hang while
+                # the live ranks wait for our dispatch.
+                e = self._zero_entry(name, outcome.metas.get(name, ""))
+                if e is None:
+                    with self._lock:
+                        self._join_requested = False
+                        self._join_result = -1
+                    self._join_event.set()
+                    log.error(
+                        "join() aborted: cannot zero-participate in ready "
+                        "tensor %r (process-set or ragged collectives are "
+                        "not joinable)", name)
+                    continue
+                handles[id(e)] = Handle(e.name)  # result dropped
+            if e is not None:
+                ready.append(e)
         ready_ids = {id(e) for e in ready}
         deferred = [(e, h) for e, h in batch if id(e) not in ready_ids]
         if deferred:
@@ -277,9 +352,90 @@ class CollectiveEngine:
                 self._queue = deferred + self._queue
         for group in self._fuse(ready):
             self._execute_group(group, handles)
+        if join_req and outcome.all_joined:
+            with self._lock:
+                self._join_requested = False
+                self._join_result = outcome.last_join_rank
+            self._join_event.set()
         if self._autotuner is not None:
             payload = sum(self._entry_bytes(e) for e in ready)
             self._autotuner.record_cycle(payload, time.monotonic() - t0)
+
+    # -- join († RequestType::JOIN, hvd.join()) ------------------------------
+    def join(self, timeout: Optional[float] = None) -> int:
+        """Signal this rank has no more input; participate as zeros in
+        other ranks' collectives until every rank joins.  Returns the last
+        rank to join († ``horovod/torch/__init__.py join()``)."""
+        if not self.distributed:
+            raise RuntimeError(
+                "engine.join() requires distributed (multi-process) mode; "
+                "single-controller callers use the barrier fallback")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # Drain our own pending collectives first: a joining rank has no
+        # more inputs, so everything already enqueued must dispatch before
+        # the JOIN flag is raised (matching the reference, where JOIN is
+        # itself a queued request ordered after prior submissions).
+        while True:
+            with self._lock:
+                if not self._queue and not self._names_pending:
+                    break
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("join(): pending collectives never drained")
+            self.nudge()
+            time.sleep(0.005)
+        self._join_event.clear()
+        with self._wake:
+            self._join_requested = True
+            self._urgent = True
+            self._wake.notify_all()
+        remaining = None if deadline is None else \
+            max(0.0, deadline - time.monotonic())
+        if not self._join_event.wait(remaining):
+            # The JOIN flag already sent to the controller is irrevocable
+            # (other ranks' tensors may have become ready through our
+            # implicit coverage), so the engine MUST stay in joined mode
+            # and keep zero-participating; clearing the flag here would
+            # strand the other ranks mid-collective.  The caller may
+            # re-invoke join() to resume waiting — server-side join state
+            # is idempotent and a joined rank may even submit new tensors
+            # consistently (coverage is a union).
+            raise TimeoutError(
+                "join(): not all ranks joined in time (this rank remains "
+                "joined; call join() again to keep waiting)")
+        if self._join_result < 0:
+            raise HorovodInternalError("join(): failed mid-join (see log)")
+        return self._join_result
+
+    def _zero_entry(self, name: str, meta: str
+                    ) -> Optional[TensorTableEntry]:
+        """Build the zero-payload stand-in a joined rank contributes.
+
+        † JoinOp semantics: the joined rank supplies zeros of the same
+        shape/dtype; AVERAGE divides by the full world size including
+        joined ranks (reference behavior).
+        """
+        if not meta:
+            log.warning(
+                "join: tensor %r ready without metadata; cannot zero-"
+                "participate (process-set or ragged collective)", name)
+            return None
+        import numpy as np
+        try:
+            m = json.loads(meta)
+            shape = tuple(m["s"])
+            local_rows = len(self._state.local_devices)
+            zeros = np.zeros((local_rows,) + shape[1:],
+                             dtype=np.dtype(m["d"]))
+            payload = C.from_local(zeros)
+        except Exception as err:
+            log.error("join: failed to build zero entry for %r: %s",
+                      name, err)
+            return None
+        return TensorTableEntry(
+            name=name, verb=m["v"], payload=payload,
+            op=C.ReduceOp(m["o"]), root_rank=m.get("r", 0),
+            splits=m.get("sp"), prescale=m.get("ps", 1.0),
+            postscale=m.get("po", 1.0))
 
     @staticmethod
     def _entry_bytes(e: TensorTableEntry) -> int:

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from .engine import Negotiator, TensorTableEntry
+from .engine import NegotiationOutcome, Negotiator, TensorTableEntry
 from ..utils import logging as hvd_logging
 
 log = hvd_logging.get_logger()
@@ -28,21 +28,27 @@ class DistributedNegotiator(Negotiator):
                                         timeout_ms=timeout_ms)
         self._warned: set[str] = set()
 
-    def negotiate(self, entries: list[TensorTableEntry]
-                  ) -> list[TensorTableEntry]:
-        by_name = {e.name: e for e in entries}
-        ready_names, stalled = self._client.negotiate(list(by_name))
-        for name in stalled:
+    def negotiate(self, entries: list[TensorTableEntry], *,
+                  joined: bool = False) -> NegotiationOutcome:
+        pairs = []
+        seen = set()
+        for e in entries:
+            if e.name in seen:
+                continue
+            seen.add(e.name)
+            pairs.append((e.name, e.meta()))
+        res = self._client.negotiate(pairs, joined=joined)
+        for name in res.stalled:
             if name not in self._warned:
                 self._warned.add(name)
                 log.warning(
                     "Negotiation stall: tensor %r submitted by some ranks "
                     "but not all († stall_inspector)", name)
-        # Order comes from the coordinator; drop names this process hasn't
-        # enqueued yet (they'll be ready here in a later cycle — the
-        # coordinator only marks globally-ready tensors, so this only
-        # happens transiently on requeue races).
-        return [by_name[n] for n in ready_names if n in by_name]
+        # Ready order comes from the coordinator; the engine maps names to
+        # local entries (or join zero-participation for names it lacks).
+        return NegotiationOutcome(
+            ready=res.ready, stalled=res.stalled, metas=res.metas,
+            all_joined=res.all_joined, last_join_rank=res.last_join_rank)
 
     def close(self) -> None:
         self._client.close()

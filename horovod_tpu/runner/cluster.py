@@ -123,9 +123,11 @@ def placement_info() -> str:
 
 def placement_env(infos: List[str], rank: int, coord_port: int
                   ) -> Dict[str, str]:
-    hosts = [i.split("|", 1)[0] for i in infos]
+    # Group on the full "hostname|ip" pair: containerized Spark/Ray
+    # clusters can give distinct hosts identical default hostnames, which
+    # would mis-assign local ranks if hostname alone were the key.
     rank0_ip = infos[0].split("|", 1)[1]
     return {
-        "HVDTPU_LOCAL_RANK": str(local_ranks(hosts)[rank]),
+        "HVDTPU_LOCAL_RANK": str(local_ranks(infos)[rank]),
         "HVDTPU_COORDINATOR_ADDR": f"{rank0_ip}:{coord_port}",
     }

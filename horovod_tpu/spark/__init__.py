@@ -66,6 +66,15 @@ def run(fn: Callable,
 
     ``fn`` runs on each executor; call ``hvd.init()`` inside it.
     ``num_proc`` defaults to the cluster's default parallelism.
+
+    Security note: the per-rank env blocks — including the job's HMAC
+    secret (``HVDTPU_SECRET``) — travel inside the task closure that Spark
+    pickles to executors, so the secret transits Spark task serialization
+    and may appear in event logs if closure logging is enabled (upstream's
+    Spark path has the same exposure).  The secret is per-job and expires
+    with the driver services; for stricter handling, pre-distribute a
+    secret via your cluster's credential mechanism and set ``HVDTPU_SECRET``
+    in the executor environment instead.
     """
     try:
         from pyspark.sql import SparkSession

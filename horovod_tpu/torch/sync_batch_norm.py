@@ -112,10 +112,8 @@ class SyncBatchNorm(_BatchNorm):
                 self.running_var if track else None,
                 self.weight, self.bias, bn_training, eaf, self.eps)
 
-        weight = self.weight if self.affine else \
-            torch.ones(x.shape[1], dtype=x.dtype)
-        bias = self.bias if self.affine else \
-            torch.zeros(x.shape[1], dtype=x.dtype)
+        weight = self.weight if self.affine else x.new_ones(x.shape[1])
+        bias = self.bias if self.affine else x.new_zeros(x.shape[1])
         y, mean, var, total = _SyncBatchNormFn.apply(x, weight, bias,
                                                      self.eps)
 

@@ -1,0 +1,29 @@
+"""Force the JAX host-CPU platform for multi-device test/dev rigs.
+
+One canonical copy of the recipe every CPU-rig entry point needs (the dev
+image pins an ``axon`` TPU platform via sitecustomize whose initialization
+can hang when the tunnel is down, and it ignores the ``JAX_PLATFORMS`` env
+var — only ``jax.config`` set before any backend touch wins).
+
+Import this module (or the package) freely before calling: importing jax
+does not initialize a backend; only device queries/computation do.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_platform(n_devices: int = 1) -> None:
+    """Pin jax to ``n_devices`` virtual host-CPU devices.
+
+    Must run before anything touches a JAX backend (``jax.devices()``,
+    any computation); afterwards ``jax.config.update`` is a silent no-op.
+    """
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -563,15 +563,26 @@ class KvClient {
 // which named tensors are ready on every rank and in what order they fuse.
 //
 // Round protocol (client -> server frame):
-//   u32 rank, u32 n_entries, then per entry either
-//     'N' + str name   (first sighting — server assigns an id)
+//   u32 rank, u8 flags (bit0: this rank has JOINed — no more inputs,
+//   † message.h RequestType::JOIN), u32 n_entries, then per entry either
+//     'N' + str name + str meta  (first sighting — server assigns an id;
+//                                 meta is an opaque descriptor the engine
+//                                 uses to build zero-payload participation
+//                                 on joined ranks)
 //   or
 //     'I' + u32 id     (cache fast path † bit-vector exchange)
 // Server reply:
-//   u32 n_ready, then per ready tensor: u32 id + str name
+//   u32 n_ready, then per ready tensor: u32 id + str name + str meta
 //   (names echoed so new ranks can learn ids; † Response joined names),
 //   then u32 n_stalled (informational: tensors some ranks submitted but
-//   others haven't for > stall_warn_ms — † stall_inspector.cc).
+//   others haven't for > stall_warn_ms — † stall_inspector.cc),
+//   then u8 all_joined (1 once every rank has joined) + u32 last_join_rank.
+//
+// JOIN semantics: a joined rank counts as having implicitly submitted every
+// tensor (it will participate with zeros), so readiness = every rank either
+// saw the tensor or joined.  When all ranks have joined, the all_joined
+// flag is reported once (with the last rank to join — the † hvd.join()
+// return value) and join state resets for the next phase.
 //
 // Ordering invariant: ready tensors are ordered by the round in which they
 // first became globally known, then by rank-0's submission order — giving
@@ -581,6 +592,7 @@ class KvClient {
 struct TensorState {
   uint32_t id;
   std::string name;
+  std::string meta;
   std::set<uint32_t> ranks_seen;
   uint64_t first_seen_round;
   Clock::time_point first_seen_time;
@@ -645,13 +657,16 @@ class Controller {
     while (!stopping_ && recv_auth_frame(fd, &ch, &frame)) {
       size_t off = 0;
       uint32_t rank = get_u32(frame, &off);
+      uint8_t flags = static_cast<uint8_t>(frame[off++]);
       uint32_t n = get_u32(frame, &off);
-      std::vector<std::string> names;
+      std::vector<std::pair<std::string, std::string>> names;  // (name, meta)
       std::vector<uint32_t> ids;
       for (uint32_t i = 0; i < n; ++i) {
         char tag = frame[off++];
         if (tag == 'N') {
-          names.push_back(get_str(frame, &off));
+          std::string nm = get_str(frame, &off);
+          std::string meta = get_str(frame, &off);
+          names.emplace_back(std::move(nm), std::move(meta));
         } else {
           ids.push_back(get_u32(frame, &off));
         }
@@ -663,8 +678,11 @@ class Controller {
         rank_fds_[rank] = fd;
       }
       // Record submissions.
-      for (auto& nm : names) RecordName(rank, nm);
+      for (auto& nm : names) RecordName(rank, nm.first, nm.second);
       for (uint32_t id : ids) RecordId(rank, id);
+      if (flags & 1) {
+        if (joined_.insert(rank).second) last_join_rank_ = rank;
+      }
       arrived_.insert(rank);
 
       uint64_t round = round_;
@@ -686,20 +704,27 @@ class Controller {
     ::close(fd);
   }
 
-  void RecordName(uint32_t rank, const std::string& name) {
+  void RecordName(uint32_t rank, const std::string& name,
+                  const std::string& meta) {
     auto it = by_name_.find(name);
     if (it == by_name_.end()) {
       uint32_t id = next_id_++;
       TensorState st;
       st.id = id;
       st.name = name;
+      st.meta = meta;
       st.first_seen_round = round_;
       st.first_seen_time = Clock::now();
       st.ranks_seen.insert(rank);
       tensors_[id] = std::move(st);
       by_name_[name] = id;
     } else {
-      Touch(tensors_[it->second], rank);
+      TensorState& st = tensors_[it->second];
+      // A resubmission carrying meta refreshes it (clients bypass the id
+      // fast path when a tensor's descriptor changes, e.g. a tail batch
+      // with a different shape — joined ranks need the current one).
+      if (!meta.empty()) st.meta = meta;
+      Touch(st, rank);
     }
   }
 
@@ -722,13 +747,19 @@ class Controller {
   }
 
   void BuildResponse() {
-    // Ready = seen on every rank; ordered by (first_seen_round, id).
+    // Ready = seen-or-joined on every rank; ordered by
+    // (first_seen_round, id).  Joined ranks implicitly submit everything
+    // († JoinOp: a joined rank participates as zeros).
     std::vector<const TensorState*> ready;
     std::vector<const TensorState*> stalled;
     auto now = Clock::now();
     for (auto& [id, st] : tensors_) {
       if (st.ranks_seen.empty()) continue;  // idle between cycles
-      if (st.ranks_seen.size() == size_) {
+      size_t covered = st.ranks_seen.size();
+      for (uint32_t jr : joined_) {
+        if (!st.ranks_seen.count(jr)) ++covered;
+      }
+      if (covered == size_) {
         ready.push_back(&st);
       } else if (stall_warn_ms_ > 0 &&
                  std::chrono::duration_cast<std::chrono::milliseconds>(
@@ -748,10 +779,20 @@ class Controller {
     for (auto* st : ready) {
       put_u32(&resp, st->id);
       put_str(&resp, st->name);
+      put_str(&resp, st->meta);
       const_cast<TensorState*>(st)->ranks_seen.clear();
     }
     put_u32(&resp, static_cast<uint32_t>(stalled.size()));
     for (auto* st : stalled) put_str(&resp, st->name);
+    uint8_t all_joined = joined_.size() == size_ ? 1 : 0;
+    resp += static_cast<char>(all_joined);
+    put_u32(&resp, last_join_rank_);
+    if (all_joined) {
+      // Reported exactly once to every rank of this round; reset so the
+      // job can enter another uneven-input phase.
+      joined_.clear();
+      last_join_rank_ = 0;
+    }
     last_response_ = resp;
   }
 
@@ -769,6 +810,8 @@ class Controller {
   std::map<uint32_t, int> rank_fds_;
   std::set<int> all_fds_;
   std::set<uint32_t> arrived_;
+  std::set<uint32_t> joined_;
+  uint32_t last_join_rank_ = 0;
   uint64_t round_ = 0;
   uint32_t next_id_ = 0;
   std::unordered_map<std::string, uint32_t> by_name_;
@@ -794,23 +837,33 @@ class CtrlClient {
   }
   bool ok() const { return fd_ >= 0; }
 
-  // names: the tensors newly ready on this rank this round.  Returns the
-  // agreed globally-ready ordered list (empty on protocol failure with
-  // *err set).
-  bool Negotiate(const std::vector<std::string>& names,
-                 std::vector<std::string>* ready,
-                 std::vector<std::string>* stalled) {
+  // entries: (name, meta) for the tensors pending on this rank this round
+  // (meta travels only on first sighting; cached names go as ids).
+  // joined: this rank has no more inputs († RequestType::JOIN).
+  // Returns the agreed globally-ready ordered list with each tensor's
+  // meta, plus the all-joined signal.
+  bool Negotiate(const std::vector<std::pair<std::string, std::string>>& entries,
+                 bool joined,
+                 std::vector<std::pair<std::string, std::string>>* ready,
+                 std::vector<std::string>* stalled, bool* all_joined,
+                 uint32_t* last_join_rank) {
     std::string msg;
     put_u32(&msg, rank_);
-    put_u32(&msg, static_cast<uint32_t>(names.size()));
-    for (auto& nm : names) {
-      auto it = cache_.find(nm);
-      if (it != cache_.end()) {
+    msg += static_cast<char>(joined ? 1 : 0);
+    put_u32(&msg, static_cast<uint32_t>(entries.size()));
+    for (auto& e : entries) {
+      auto it = cache_.find(e.first);
+      // Id fast path only while the descriptor is unchanged; a meta change
+      // (e.g. tail batch with a new shape) must reach the server so joined
+      // ranks zero-participate with the current shape/dtype.
+      if (it != cache_.end() && meta_cache_[e.first] == e.second) {
         msg += 'I';
         put_u32(&msg, it->second);
       } else {
         msg += 'N';
-        put_str(&msg, nm);
+        put_str(&msg, e.first);
+        put_str(&msg, e.second);
+        meta_cache_[e.first] = e.second;
       }
     }
     std::string reply;
@@ -823,14 +876,17 @@ class CtrlClient {
     for (uint32_t i = 0; i < n_ready; ++i) {
       uint32_t id = get_u32(reply, &off);
       std::string nm = get_str(reply, &off);
+      std::string meta = get_str(reply, &off);
       cache_[nm] = id;
-      ready->push_back(std::move(nm));
+      ready->emplace_back(std::move(nm), std::move(meta));
     }
     uint32_t n_stalled = get_u32(reply, &off);
     stalled->clear();
     for (uint32_t i = 0; i < n_stalled; ++i) {
       stalled->push_back(get_str(reply, &off));
     }
+    *all_joined = reply[off++] != 0;
+    *last_join_rank = get_u32(reply, &off);
     return true;
   }
 
@@ -841,6 +897,7 @@ class CtrlClient {
   uint32_t rank_;
   AuthChannel ch_;
   std::unordered_map<std::string, uint32_t> cache_;
+  std::unordered_map<std::string, std::string> meta_cache_;
 };
 
 }  // namespace
@@ -923,28 +980,48 @@ void* hvd_ctrl_connect(const char* host, int port, int rank, int timeout_ms,
   return c;
 }
 
-// names_blob: '\n'-joined tensor names ('' = none).  On success writes
-// '\n'-joined ready list then '\x01' then '\n'-joined stalled list into out
+// names_blob: '\n'-joined entries ('' = none), each "name" or
+// "name\x02meta".  joined: nonzero when this rank has JOINed.  On success
+// writes '\n'-joined ready entries ("name\x02meta") then '\x01' then
+// '\n'-joined stalled names into out, sets *all_joined / *last_join_rank,
 // and returns total length (or required length if > cap; -1 on failure).
-int hvd_ctrl_negotiate(void* c, const char* names_blob, char* out, int cap) {
-  std::vector<std::string> names;
+int hvd_ctrl_negotiate(void* c, const char* names_blob, int joined_flag,
+                       char* out, int cap, int* all_joined,
+                       int* last_join_rank) {
+  std::vector<std::pair<std::string, std::string>> entries;
   {
     std::string blob(names_blob);
     size_t start = 0;
     while (start < blob.size()) {
       size_t nl = blob.find('\n', start);
       if (nl == std::string::npos) nl = blob.size();
-      if (nl > start) names.push_back(blob.substr(start, nl - start));
+      if (nl > start) {
+        std::string item = blob.substr(start, nl - start);
+        size_t sep = item.find('\x02');
+        if (sep == std::string::npos) {
+          entries.emplace_back(std::move(item), "");
+        } else {
+          entries.emplace_back(item.substr(0, sep), item.substr(sep + 1));
+        }
+      }
       start = nl + 1;
     }
   }
-  std::vector<std::string> ready, stalled;
-  if (!static_cast<CtrlClient*>(c)->Negotiate(names, &ready, &stalled))
+  std::vector<std::pair<std::string, std::string>> ready;
+  std::vector<std::string> stalled;
+  bool aj = false;
+  uint32_t last = 0;
+  if (!static_cast<CtrlClient*>(c)->Negotiate(entries, joined_flag != 0,
+                                              &ready, &stalled, &aj, &last))
     return -1;
+  if (all_joined != nullptr) *all_joined = aj ? 1 : 0;
+  if (last_join_rank != nullptr) *last_join_rank = static_cast<int>(last);
   std::string joined;
   for (size_t i = 0; i < ready.size(); ++i) {
     if (i) joined += '\n';
-    joined += ready[i];
+    joined += ready[i].first;
+    joined += '\x02';
+    joined += ready[i].second;
   }
   joined += '\x01';
   for (size_t i = 0; i < stalled.size(); ++i) {

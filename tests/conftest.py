@@ -9,16 +9,9 @@ image pins an ``axon`` TPU platform via sitecustomize, so we override with
 ``jax.config`` (which wins as long as no backend has been touched yet).
 """
 
-import os
+from horovod_tpu.utils.cpurig import force_cpu_platform
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "")
-    + " --xla_force_host_platform_device_count=8"
-)
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu_platform(8)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,45 @@
+"""Worker for the uneven-input join() e2e test († test_horovod_join).
+
+Rank 0 has 3 batches, rank 1 has 5: after step 3 rank 0 calls join() and
+participates as zeros while rank 1 finishes; both processes terminate
+cleanly and every allreduce result is checked against the uneven-input
+semantics († RequestType::JOIN — Average divides by the full world size
+including joined ranks).
+"""
+
+import sys
+
+from horovod_tpu.utils.cpurig import force_cpu_platform
+
+force_cpu_platform(1)
+
+import numpy as np  # noqa: E402
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main() -> int:
+    hvd.init()
+    me = hvd.cross_rank()
+    n = hvd.size()
+    assert n == 2, f"join worker expects 2 ranks, got {n}"
+
+    my_steps = 3 if me == 0 else 5
+    for step in range(my_steps):
+        x = hvd.from_local(np.full((1, 4), float(me + 1 + step), np.float32))
+        out = hvd.to_numpy(hvd.allreduce(x, hvd.Average, process_set=None))
+        if step < 3:
+            want = np.mean([r + 1 + step for r in range(n)])
+        else:
+            # Rank 0 joined: contributes zeros, Average still divides by n.
+            want = (1 + 1 + step) / n
+        assert np.allclose(out, want), (me, step, out, want)
+
+    last = hvd.join(timeout=60)
+    assert last == 1, f"rank {me}: expected last joiner 1, got {last}"
+    print(f"rank {me}: JOIN-OK last={last}")
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -142,7 +142,7 @@ def test_negotiator_failure_fails_handles():
     class ExplodingNegotiator(Negotiator):
         always_check_in = False
 
-        def negotiate(self, entries):
+        def negotiate(self, entries, *, joined=False):
             raise ConnectionError("controller gone")
 
     eng = hvd.global_state().engine

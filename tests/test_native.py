@@ -115,9 +115,9 @@ def _run_ranks(port, size, submissions_per_rank, rounds):
 
         def go(r):
             barrier.wait()
-            ready, stalled = clients[r].negotiate(
+            res = clients[r].negotiate(
                 submissions_per_rank[rnd].get(r, []))
-            out[r] = (ready, stalled)
+            out[r] = (res.ready, res.stalled)
 
         threads = [threading.Thread(target=go, args=(r,))
                    for r in range(size)]
@@ -230,8 +230,8 @@ import sys
 from horovod_tpu._native import ControllerClient
 rank, port = int(sys.argv[1]), int(sys.argv[2])
 c = ControllerClient("127.0.0.1", port, rank)
-ready, _ = c.negotiate([f"grad.{i}" for i in range(3)])
-print(",".join(ready))
+res = c.negotiate([f"grad.{i}" for i in range(3)])
+print(",".join(res.ready))
 c.close()
 """
 
@@ -251,6 +251,83 @@ def test_negotiate_multiprocess():
             outs.append(stdout.strip())
         assert len(set(outs)) == 1
         assert outs[0] == "grad.0,grad.1,grad.2"
+
+
+# ---------------------------------------------------------------------------
+# JOIN protocol († message.h RequestType::JOIN): a joined rank counts as an
+# implicit submitter for every tensor; all-joined is reported with the last
+# rank to join (the hvd.join() return value).
+# ---------------------------------------------------------------------------
+
+def _round(clients, subs, joined=()):
+    """One synchronized negotiation round; subs: rank -> [names or pairs]."""
+    out = {}
+    barrier = threading.Barrier(len(clients))
+
+    def go(r):
+        barrier.wait()
+        out[r] = clients[r].negotiate(subs.get(r, []), joined=r in joined)
+
+    ts = [threading.Thread(target=go, args=(r,)) for r in range(len(clients))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    return out
+
+
+def test_join_makes_tensor_ready_with_metadata():
+    with ControllerServer(size=2) as srv:
+        clients = [ControllerClient("127.0.0.1", srv.port, r)
+                   for r in range(2)]
+        # Rank 1 joined; rank 0 submits a tensor with metadata — it must be
+        # ready immediately, and rank 1 must receive the metadata to build
+        # its zero participation.
+        out = _round(clients, {0: [("grad.a", '{"v":"allreduce"}')]},
+                     joined={1})
+        for r in range(2):
+            assert out[r].ready == ["grad.a"]
+            assert out[r].metas["grad.a"] == '{"v":"allreduce"}'
+            assert not out[r].all_joined
+        for c in clients:
+            c.close()
+
+
+def test_join_all_joined_reports_last_rank():
+    with ControllerServer(size=3) as srv:
+        clients = [ControllerClient("127.0.0.1", srv.port, r)
+                   for r in range(3)]
+        out = _round(clients, {}, joined={2})
+        assert not out[0].all_joined
+        out = _round(clients, {}, joined={2, 0})
+        assert not out[0].all_joined
+        out = _round(clients, {}, joined={2, 0, 1})
+        for r in range(3):
+            assert out[r].all_joined
+            assert out[r].last_join_rank == 1
+        # Join state resets: a later phase can run another uneven epoch.
+        out = _round(clients, {r: ["t.next"] for r in range(3)})
+        assert out[0].ready == ["t.next"]
+        assert not out[0].all_joined
+        for c in clients:
+            c.close()
+
+
+def test_join_metadata_survives_cache_fast_path():
+    # Meta travels on first sighting; later id-cached rounds must still
+    # deliver it to a rank that joins afterwards.
+    with ControllerServer(size=2) as srv:
+        clients = [ControllerClient("127.0.0.1", srv.port, r)
+                   for r in range(2)]
+        subs = {r: [("g", '{"d":"float32"}')] for r in range(2)}
+        out = _round(clients, subs)
+        assert out[0].ready == ["g"]
+        # Round 2: rank 1 joins; rank 0 resubmits via the id fast path.
+        out = _round(clients, {0: [("g", '{"d":"float32"}')]}, joined={1})
+        assert out[1].ready == ["g"]
+        assert out[1].metas["g"] == '{"d":"float32"}'
+        for c in clients:
+            c.close()
 
 
 # ---------------------------------------------------------------------------
@@ -302,8 +379,7 @@ def test_ctrl_auth_negotiation():
 
         def rank_fn(r):
             c = ControllerClient("127.0.0.1", srv.port, r, secret="job")
-            ready, _ = c.negotiate(["t0"])
-            results[r] = ready
+            results[r] = c.negotiate(["t0"]).ready
             c.close()
 
         ts = [threading.Thread(target=rank_fn, args=(r,)) for r in range(2)]

@@ -121,6 +121,16 @@ def test_hvdrun_no_command():
 
 
 @pytest.mark.integration
+def test_hvdrun_join_uneven_inputs():
+    """† test_horovod_join: rank 0 runs 3 steps, rank 1 runs 5; the job
+    completes (no deadlock) and surviving-step allreduces are correct."""
+    res = _hvdrun(2, [os.path.join(REPO, "tests", "mp_join_worker.py")])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "rank 0: JOIN-OK last=1" in res.stdout
+    assert "rank 1: JOIN-OK last=1" in res.stdout
+
+
+@pytest.mark.integration
 def test_hvdrun_sync_batch_norm():
     """† sync_batch_norm semantics over 2 real processes with different
     shards, against a concatenated-batch BatchNorm oracle."""
