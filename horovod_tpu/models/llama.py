@@ -177,6 +177,29 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def _attn_block(h, lp, positions, cfg: LlamaConfig, attention):
+    """Shared attention sub-block: RMSNorm -> QKV -> RoPE -> GQA expand ->
+    ``attention`` callable -> output projection + residual."""
+    x = _rmsnorm(h, lp["attn_norm"])
+    q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"])
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if cfg.n_kv_heads != cfg.n_heads:                  # GQA expand
+        rep = cfg.n_heads // cfg.n_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return h + jnp.einsum("bshk,hkd->bsd", attention(q, k, v), lp["wo"])
+
+
+def _dense_mlp(x2, lp):
+    """SwiGLU MLP shared by the scan and pipeline paths."""
+    g = jax.nn.silu(jnp.einsum("bsd,df->bsf", x2, lp["w_gate"]))
+    u = jnp.einsum("bsd,df->bsf", x2, lp["w_up"])
+    return jnp.einsum("bsf,fd->bsd", g * u, lp["w_down"])
+
+
 def _attention(q, k, v, mesh: Optional[Mesh], causal: bool) -> jax.Array:
     """Dispatch: ring attention when the sequence is sp-sharded; the Pallas
     flash kernel on TPU for supported shapes (shard_mapped over the mesh so
@@ -253,10 +276,94 @@ def _moe_mlp(h2, lp, cfg: LlamaConfig, mesh: Optional[Mesh]):
     return out.reshape(B, S, D), aux
 
 
+def _pick_microbatches(batch: int, mesh: Mesh) -> int:
+    """Most microbatches <= 2*pp that divide the batch and keep each
+    microbatch divisible by the data axes (GPipe bubble (S-1)/(M+S-1);
+    callers with large batches get M = 2*pp)."""
+    pp = mesh.shape.get("pp", 1)
+    df = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    for m in range(min(2 * pp, batch), 0, -1):
+        if batch % m == 0 and (batch // m) % df == 0:
+            return m
+    return 1
+
+
+def _forward_pipelined(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+                       mesh: Mesh, causal: bool
+                       ) -> tuple[jax.Array, jax.Array]:
+    """pp>1 path: the layer stack runs as a real GPipe microbatch schedule
+    (:func:`horovod_tpu.parallel.pipeline.pipeline_apply_local`) with each
+    stage's parameters RESIDENT on its pp rank and activations handed over
+    with ``ppermute`` — never a per-layer parameter gather across pp (the
+    anti-pattern this replaces: scanning a pp-sharded layer stack makes
+    GSPMD all-gather every layer's weights each step, turning the one axis
+    meant to tolerate DCN into a per-layer DCN fetch).
+
+    The pipeline shard_map is manual over pp only; dp/fsdp/tp stay
+    automatic, so Megatron-style tp sharding inside each stage still
+    compiles to GSPMD collectives.  sp/ep run their own manual collectives
+    and currently require pp=1 meshes.
+    """
+    pp = mesh.shape["pp"]
+    if cfg.use_moe or mesh.shape.get("sp", 1) > 1:
+        raise NotImplementedError(
+            "pp>1 composes with dp/fsdp/tp; sp and ep (MoE) axes need a "
+            "pp=1 mesh — their manual collectives don't nest inside the "
+            "pipeline's pp-manual shard_map yet")
+    if cfg.n_layers % pp:
+        raise ValueError(
+            f"pp={pp} must divide n_layers={cfg.n_layers} evenly")
+    from ..ops.flash_attention import dense_attention
+    from ..parallel.pipeline import pipeline_apply_local
+
+    B, S = tokens.shape
+    D = cfg.d_model
+    h = params["embed"].astype(cfg.dtype)[tokens]           # [B,S,D]
+    h = shd.constrain(h, ("batch", "seq", None), mesh)
+    M = _pick_microbatches(B, mesh)
+    mb = B // M
+    positions = jnp.broadcast_to(jnp.arange(S), (mb, S))
+
+    # Inside the pp-manual shard_map, the flash kernel's own dp/tp
+    # shard_map can't nest, so attention runs as dense XLA einsums on the
+    # auto axes (GSPMD-partitioned).  Flash-in-pipeline is a known
+    # optimization gap, not a correctness one.
+    def attention(q, k, v):
+        return dense_attention(q, k, v, 1.0 / np.sqrt(cfg.head_dim), causal)
+
+    def layer_body(h, lp):
+        h = _attn_block(h, lp, positions, cfg, attention)
+        return h + _dense_mlp(_rmsnorm(h, lp["mlp_norm"]), lp)
+
+    body = jax.checkpoint(layer_body) if cfg.remat else layer_body
+
+    def stage_fn(local_layers, x):
+        # One pp rank's resident layers applied in sequence (scan: one
+        # compiled body regardless of depth).
+        out, _ = lax.scan(lambda c, lp: (body(c, lp), None), x, local_layers)
+        return out
+
+    def local(local_layers, mbs):
+        return pipeline_apply_local(stage_fn, local_layers, mbs,
+                                    axis_name="pp")
+
+    hmb = h.reshape(M, mb, S, D)
+    layer_specs = jax.tree.map(lambda _: P("pp"), params["layers"])
+    fn = shard_map(local, mesh=mesh, in_specs=(layer_specs, P()),
+                   out_specs=P(), axis_names={"pp"}, check_vma=False)
+    h = fn(params["layers"], hmb).reshape(B, S, D)
+    h = _rmsnorm(h, params["final_norm"])
+    logits = jnp.einsum("bsd,dv->bsv", h, params["lm_head"])
+    logits = shd.constrain(logits, ("batch", "seq", "vocab"), mesh)
+    return logits.astype(jnp.float32), jnp.zeros((), jnp.float32)
+
+
 def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, *,
             mesh: Optional[Mesh] = None, causal: bool = True
             ) -> tuple[jax.Array, jax.Array]:
     """Logits for next-token prediction.  Returns (logits, moe_aux_loss)."""
+    if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        return _forward_pipelined(params, tokens, cfg, mesh, causal)
     B, S = tokens.shape
     h = params["embed"].astype(cfg.dtype)[tokens]           # [B,S,D]
     h = shd.constrain(h, ("batch", "seq", None), mesh) if mesh else h
@@ -264,28 +371,14 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, *,
 
     def layer_body(carry, lp):
         h, aux = carry
-        # -- attention --
-        x = _rmsnorm(h, lp["attn_norm"])
-        q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"])
-        k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"])
-        v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"])
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
-        if cfg.n_kv_heads != cfg.n_heads:                  # GQA expand
-            rep = cfg.n_heads // cfg.n_kv_heads
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        attn = _attention(q, k, v, mesh, causal)
-        h = h + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
-        # -- mlp --
+        h = _attn_block(h, lp, positions, cfg,
+                        lambda q, k, v: _attention(q, k, v, mesh, causal))
         x2 = _rmsnorm(h, lp["mlp_norm"])
         if cfg.use_moe:
             mlp_out, moe_aux = _moe_mlp(x2, lp, cfg, mesh)
             aux = aux + moe_aux
         else:
-            g = jax.nn.silu(jnp.einsum("bsd,df->bsf", x2, lp["w_gate"]))
-            u = jnp.einsum("bsd,df->bsf", x2, lp["w_up"])
-            mlp_out = jnp.einsum("bsf,fd->bsd", g * u, lp["w_down"])
+            mlp_out = _dense_mlp(x2, lp)
         h = h + mlp_out
         if mesh is not None:
             h = shd.constrain(h, ("batch", "seq", None), mesh)

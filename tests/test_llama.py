@@ -93,6 +93,51 @@ def test_train_step_moe_ep():
     assert losses[-1] < losses[0], f"no learning: {losses}"
 
 
+def test_pp_pipeline_matches_dp_oracle():
+    """pp>1 runs the real GPipe schedule (stage-resident params,
+    ppermute'd activations) and must be loss-equivalent to plain DP."""
+    dp_losses, _, _ = _train_losses(MeshConfig(dp=8), n_steps=3)
+    pp_losses, _, _ = _train_losses(MeshConfig(pp=2, dp=2, tp=2), n_steps=3)
+    np.testing.assert_allclose(dp_losses, pp_losses, rtol=1e-4)
+
+
+def test_pp_pipeline_no_per_layer_param_gather():
+    """The pp axis must never all-gather stage parameters: the compiled
+    step shows collective-permutes (pipeline handoffs) and no all-gather
+    whose result is a full stacked layer weight (the anti-pattern where
+    scanning a pp-sharded stack makes GSPMD fetch every layer's params)."""
+    import re
+    mesh = build_mesh(MeshConfig(pp=2, dp=2, tp=2))
+    cfg = llama.LlamaConfig.tiny(n_layers=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), mesh)
+    tx = optax.adam(1e-2)
+    opt_state = jax.jit(tx.init)(params)
+    step = llama.make_train_step(cfg, mesh, tx)
+    batch = jax.device_put(_batch(cfg, B=8, S=32),
+                           NamedSharding(mesh, P(("dp", "fsdp"))))
+    txt = step.lower(params, opt_state, batch).compile().as_text()
+    assert "collective-permute" in txt, "no pipeline handoffs compiled"
+    # Full stacked weight shapes (w_gate/w_up [L,D,F], w_down [L,F,D],
+    # wq/wo [L,D,H,Dh]-ish): no all-gather may produce them.
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    banned = {f"[{L},{D},{F}]", f"[{L},{F},{D}]",
+              f"[{L},{D},{cfg.n_heads},{cfg.head_dim}]"}
+    for line in txt.splitlines():
+        if "all-gather" in line:
+            for shape in banned:
+                assert shape not in line.replace(" ", ""), (
+                    f"per-layer param gather over pp: {line[:160]}")
+
+
+def test_pp_rejects_sp_and_moe():
+    mesh = build_mesh(MeshConfig(pp=2, sp=2, dp=2))
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = jnp.asarray(np.zeros((4, 8), np.int32))
+    with pytest.raises(NotImplementedError, match="pp=1"):
+        llama.forward(params, tok, cfg, mesh=mesh)
+
+
 def _train_losses(mesh_cfg, n_steps=4, seed=0):
     mesh = build_mesh(mesh_cfg)
     cfg = llama.LlamaConfig.tiny()
