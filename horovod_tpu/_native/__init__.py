@@ -29,13 +29,34 @@ class NegotiationResult(NamedTuple):
     all_joined: bool
     last_join_rank: int
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(os.path.dirname(_PKG_DIR))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "libhvdtpu_core.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _lib_lock = threading.Lock()
+
+
+def _so_path() -> str:
+    """Locate (or build) the native core.
+
+    Search order: the source tree's ``native/`` when present (dev and
+    editable installs — built on demand with make, and always current),
+    else a wheel-shipped copy next to this package
+    († ``basics.py`` loading the built extension).
+    """
+    if os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+        src_so = os.path.join(_NATIVE_DIR, "libhvdtpu_core.so")
+        if not os.path.exists(src_so):
+            subprocess.run(["make", "-C", _NATIVE_DIR],
+                           check=True, capture_output=True)
+        return src_so
+    wheel_so = os.path.join(_PKG_DIR, "libhvdtpu_core.so")
+    if os.path.exists(wheel_so):
+        return wheel_so
+    raise OSError(
+        "native core not found: no packaged libhvdtpu_core.so and no "
+        f"source tree at {_NATIVE_DIR}")
 
 
 def job_secret(secret: Optional[str] = None) -> bytes:
@@ -53,10 +74,7 @@ def load() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO_PATH):
-            subprocess.run(["make", "-C", _NATIVE_DIR],
-                           check=True, capture_output=True)
-        lib = ctypes.CDLL(_SO_PATH)
+        lib = ctypes.CDLL(_so_path())
         # KV store
         lib.hvd_kv_server_start.restype = ctypes.c_void_p
         lib.hvd_kv_server_start.argtypes = [ctypes.c_int, ctypes.c_char_p]

@@ -62,6 +62,9 @@ class TensorTableEntry:
     postscale: float = 1.0
     process_set: Any = None
     enqueue_time: float = field(default_factory=time.monotonic)
+    # Timeline phase currently open for this entry ("" | QUEUE | NEGOTIATE);
+    # † timeline.cc tracks the same per-tensor lifecycle state.
+    tl_phase: str = field(default="", compare=False)
 
     def meta(self) -> str:
         """Serialized descriptor carried through negotiation so a joined
@@ -210,9 +213,19 @@ class CollectiveEngine:
         # Fail any stragglers so synchronize() callers don't hang.
         with self._lock:
             for entry, handle in self._queue:
+                self._tl_close(entry)
                 handle._complete(error=RuntimeError("engine shut down"))
             self._queue.clear()
             self._names_pending.clear()
+
+    def _tl_close(self, e: TensorTableEntry) -> None:
+        """End any open timeline span for an entry leaving the engine on an
+        error path, keeping Chrome-trace B/E events balanced."""
+        if e.tl_phase:
+            tl = self._state.timeline
+            if tl is not None and tl.enabled:
+                tl.end_activity(e.name)
+            e.tl_phase = ""
 
     def nudge(self) -> None:
         """Request an immediate cycle (used by ``synchronize`` so a blocking
@@ -247,6 +260,12 @@ class CollectiveEngine:
                 return handle
             self._names_pending.add(entry.name)
             self._queue.append((entry, handle))
+            tl = self._state.timeline
+            if tl is not None and tl.enabled:
+                # † NEGOTIATING/QUEUE phases: QUEUE = enqueue -> cycle
+                # pickup; NEGOTIATE = pickup -> globally ready.
+                tl.start_activity(entry.name, "QUEUE")
+                entry.tl_phase = "QUEUE"
             if urgent:
                 self._urgent = True
                 self._wake.notify_all()
@@ -283,6 +302,7 @@ class CollectiveEngine:
                     self._names_pending.clear()
                     self._running = False
                 for entry, handle in pending:
+                    self._tl_close(entry)
                     handle._complete(error=err)
                 log.error("engine stopped by stall shutdown: %s", err)
                 return
@@ -301,6 +321,13 @@ class CollectiveEngine:
         t0 = time.monotonic()
         entries = [e for e, _ in batch]
         handles = {id(e): h for e, h in batch}
+        tl = self._state.timeline
+        if tl is not None and tl.enabled:
+            for e in entries:
+                if e.tl_phase == "QUEUE":
+                    tl.end_activity(e.name)
+                    tl.start_activity(e.name, "NEGOTIATE")
+                    e.tl_phase = "NEGOTIATE"
         join_req = self._join_requested
         try:
             outcome = self._negotiator.negotiate(entries, joined=join_req)
@@ -312,6 +339,7 @@ class CollectiveEngine:
             for e, h in batch:
                 with self._lock:
                     self._names_pending.discard(e.name)
+                self._tl_close(e)
                 h._complete(error=err)
             if join_req:
                 with self._lock:
@@ -485,15 +513,18 @@ class CollectiveEngine:
     def _execute_group(self, group: list[TensorTableEntry],
                        handles: dict[int, Handle]) -> None:
         tl = self._state.timeline
-        names = [e.name for e in group]
         try:
-            if tl is not None:
-                for n in names:
-                    tl.start_activity(n, "DISPATCH")
+            if tl is not None and tl.enabled:
+                for e in group:
+                    if e.tl_phase == "NEGOTIATE":
+                        tl.end_activity(e.name)
+                    tl.start_activity(e.name, "DISPATCH")
+                    e.tl_phase = "DISPATCH"
             results = self._dispatch(group)
-            if tl is not None:
-                for n in names:
-                    tl.end_activity(n)
+            if tl is not None and tl.enabled:
+                for e in group:
+                    tl.end_activity(e.name)
+                    e.tl_phase = ""
             for e, r in zip(group, results):
                 with self._lock:
                     self._names_pending.discard(e.name)
@@ -504,6 +535,7 @@ class CollectiveEngine:
             for e in group:
                 with self._lock:
                     self._names_pending.discard(e.name)
+                self._tl_close(e)
                 handles[id(e)]._complete(error=err)
 
     def _dispatch(self, group: list[TensorTableEntry]) -> list[Any]:

@@ -1,0 +1,29 @@
+"""Build hook: compile the native core into the wheel.
+
+Metadata lives in pyproject.toml; this exists only so a non-editable
+``pip install .`` ships ``libhvdtpu_core.so`` inside the package (the
+ctypes bridge prefers the packaged copy and falls back to building from
+the source tree — † the reference's custom build_ext compiling the C++
+core into each framework extension).
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class build_py_with_native(build_py):
+    def run(self):
+        root = os.path.dirname(os.path.abspath(__file__))
+        native = os.path.join(root, "native")
+        if os.path.exists(os.path.join(native, "Makefile")):
+            subprocess.run(["make", "-C", native], check=True)
+            shutil.copy2(os.path.join(native, "libhvdtpu_core.so"),
+                         os.path.join(root, "horovod_tpu", "_native"))
+        super().run()
+
+
+setup(cmdclass={"build_py": build_py_with_native})

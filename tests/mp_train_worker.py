@@ -21,6 +21,15 @@ import horovod_tpu as hvd  # noqa: E402
 
 
 def main() -> int:
+    # Per-rank Chrome-trace timeline; phases self-checked below
+    # († timeline.cc QUEUE/NEGOTIATE/DISPATCH breakdown over a real
+    # multi-process negotiation).
+    import tempfile
+    tl_fd, tl_path = tempfile.mkstemp(
+        prefix=f"hvdtpu_tl_r{os.environ.get('HVDTPU_CROSS_RANK', '0')}_",
+        suffix=".json")
+    os.close(tl_fd)
+    os.environ["HOROVOD_TIMELINE"] = tl_path
     hvd.init()
     me = hvd.cross_rank()
     n = hvd.size()
@@ -49,8 +58,15 @@ def main() -> int:
     # 4. barrier
     hvd.barrier()
 
-    print(f"rank {me}: OK sum={float(out[0])}")
     hvd.shutdown()
+
+    import json
+    events = json.load(open(tl_path))
+    spans = [e["name"] for e in events if e.get("ph") == "B"]
+    for phase in ("QUEUE", "NEGOTIATE", "DISPATCH"):
+        assert phase in spans, f"timeline missing {phase}: {spans[:20]}"
+
+    print(f"rank {me}: OK sum={float(out[0])}")
     return 0
 
 

@@ -134,6 +134,32 @@ def test_timeline_writes_events(tmp_path):
     assert "DISPATCH" in names and "CYCLE" in names
 
 
+def test_timeline_engine_phases(tmp_path):
+    """The engine must emit the full per-tensor lifecycle QUEUE ->
+    NEGOTIATE -> DISPATCH († timeline.cc phase breakdown), not just the
+    dispatch span."""
+    import json
+    from horovod_tpu.utils.timeline import Timeline
+    p = tmp_path / "phases.json"
+    state = hvd.global_state()
+    old_tl = state.timeline
+    state.timeline = Timeline(str(p))
+    try:
+        x = hvd.per_rank([np.ones((2,), np.float32)] * N)
+        h = hvd.allreduce_async(x, name="t.phases")
+        hvd.synchronize(h)
+    finally:
+        state.timeline.close()
+        state.timeline = old_tl
+    events = json.load(open(p))
+    spans = [e["name"] for e in events
+             if e.get("ph") == "B" and e.get("tid", 0) > 0]
+    for phase in ("QUEUE", "NEGOTIATE", "DISPATCH"):
+        assert phase in spans, f"missing {phase} span: {spans}"
+    assert spans.index("QUEUE") < spans.index("NEGOTIATE") \
+        < spans.index("DISPATCH")
+
+
 def test_negotiator_failure_fails_handles():
     """A negotiation transport failure must error every pending handle
     rather than hanging waiters (code-review finding)."""
