@@ -122,12 +122,57 @@ def allreduce(x: Any, op: ReduceOp = Average, *,
 
 
 def allgather(x: Any, process_set=None) -> Any:
-    """Concatenate per-rank tensors along dim 0 († ``hvd.allgather``)."""
-    payload = x if isinstance(x, (list, tuple)) else \
-        _C.as_per_rank(x, process_set)
+    """Concatenate per-rank tensors along dim 0 († ``hvd.allgather``).
+
+    A list/tuple input is the ragged (``MPI_Allgatherv``) form: one piece
+    per rank this process drives (single-controller: all ranks;
+    multi-process: this process's local ranks), with per-rank row counts
+    free to differ.  See :func:`_allgather_v`.
+    """
+    if isinstance(x, (list, tuple)):
+        return _allgather_v(list(x), process_set)
+    payload = _C.as_per_rank(x, process_set)
     return _sync_via_engine_or_direct(
         lambda: _C.allgather(payload, process_set=process_set),
         "allgather", payload, process_set=process_set)
+
+
+def _allgather_v(pieces: list, process_set=None) -> Any:
+    """Ragged allgather († ``MPI_Allgatherv``), multi-process correct.
+
+    Built from two negotiated uniform collectives — no host-side
+    reassembly of other ranks' data, so the same path runs in
+    single-controller and multi-process modes:
+
+    1. allgather each rank's row count (tiny int32 collective);
+    2. pad every piece to the max count, allgather the padded block
+       (one compiled program), and index out the valid rows.
+    """
+    import numpy as _np
+    import jax.numpy as _jnp
+    arrs = [_np.asarray(p) for p in pieces]
+    if not arrs:
+        raise ValueError("allgather needs at least one local piece")
+    trailing = {a.shape[1:] for a in arrs}
+    dtypes = {a.dtype for a in arrs}
+    if len(trailing) != 1 or len(dtypes) != 1:
+        raise ValueError(
+            "allgather pieces must agree on trailing dims/dtype "
+            "(† coordinator shape-consistency check)")
+    counts = _np.array([[a.shape[0]] for a in arrs], _np.int32)
+    sizes = _C.to_numpy(allgather(
+        _C.from_local(counts, process_set), process_set=process_set))
+    sizes = sizes.reshape(-1).astype(int)
+    maxr = max(1, int(sizes.max()))
+    padded = _np.zeros((len(arrs), maxr) + arrs[0].shape[1:], arrs[0].dtype)
+    for i, a in enumerate(arrs):
+        padded[i, :a.shape[0]] = a
+    g = allgather(_C.from_local(padded, process_set),
+                  process_set=process_set)           # [n*maxr, *rest]
+    idx = _np.concatenate([
+        _np.arange(i * maxr, i * maxr + s) for i, s in enumerate(sizes)
+    ]) if sizes.sum() else _np.zeros((0,), _np.int64)
+    return g[_jnp.asarray(idx)]
 
 
 def broadcast(x: Any, root_rank: int, process_set=None) -> Any:
@@ -141,11 +186,89 @@ def broadcast(x: Any, root_rank: int, process_set=None) -> Any:
 def alltoall(x: Any, splits: Optional[Sequence[int]] = None,
              process_set=None) -> Any:
     """Scatter dim-0 slices of each rank's tensor to all ranks
-    († ``hvd.alltoall``)."""
+    († ``hvd.alltoall``).
+
+    With ``splits`` (the ``MPI_Alltoallv`` form): ``splits[j]`` rows of
+    this rank's tensor go to rank *j*.  Input may be a per-rank array
+    (same splits everywhere) or a list of pieces — one per rank this
+    process drives — whose row totals may differ.  Returns a list of
+    received tensors for this process's ranks.
+    """
+    if splits is not None or isinstance(x, (list, tuple)):
+        return _alltoall_v(x, splits, process_set)
     payload = _C.as_per_rank(x, process_set)
     return _sync_via_engine_or_direct(
         lambda: _C.alltoall(payload, splits, process_set=process_set),
         "alltoall", payload, splits=splits, process_set=process_set)
+
+
+def _alltoall_v(x: Any, splits: Optional[Sequence[int]], process_set=None
+                ) -> list:
+    """Non-uniform alltoall († ``MPI_Alltoallv``), multi-process correct.
+
+    Three negotiated uniform collectives — no host reassembly of remote
+    data: (1) allgather every rank's splits vector; (2) pad each
+    destination block to the global max split and run one compiled
+    uniform alltoall; (3) index out each local rank's valid rows.
+    """
+    import numpy as _np
+    mesh, axis = _C._mesh_axis(process_set)
+    n = mesh.shape[axis]
+    if isinstance(x, (list, tuple)):
+        arrs = [_np.asarray(p) for p in x]
+    else:
+        arrs = list(_C.to_local(_C.as_per_rank(x, process_set)))
+    local = len(arrs)
+    if splits is None:
+        raise ValueError("list-form alltoall requires splits")
+    splits = _np.asarray(splits, _np.int32)
+    if splits.ndim == 1:
+        sp_local = _np.broadcast_to(splits, (local, n)).copy()
+    else:
+        sp_local = splits.reshape(local, n).copy()
+    for a, sp in zip(arrs, sp_local):
+        if a.shape[0] != int(sp.sum()):
+            raise ValueError(
+                f"splits {sp.tolist()} must sum to rows ({a.shape[0]})")
+    # (1) everyone learns the full [n, n] send matrix.
+    S = _C.to_numpy(allgather(_C.from_local(sp_local, process_set),
+                              process_set=process_set))
+    S = S.reshape(n, n).astype(int)
+    maxs = max(1, int(S.max()))
+    # (2) pad each destination block to maxs rows; one uniform alltoall.
+    rest = arrs[0].shape[1:]
+    padded = _np.zeros((local, n * maxs) + rest, arrs[0].dtype)
+    for i, (a, sp) in enumerate(zip(arrs, sp_local)):
+        off = 0
+        for j, s in enumerate(sp):
+            padded[i, j * maxs:j * maxs + s] = a[off:off + s]
+            off += s
+    out = alltoall(_C.from_local(padded, process_set),
+                   process_set=process_set)          # per-rank [n*maxs,*rest]
+    recv = _C.to_local(out).reshape((local, n * maxs) + rest)
+    # (3) slice valid rows per local rank: rank r receives S[i][r] rows
+    # from source i, stored at block offset i*maxs.
+    first = _rank_offset(mesh, axis, process_set)
+    results = []
+    for k in range(local):
+        r = first + k
+        idx = _np.concatenate([
+            _np.arange(i * maxs, i * maxs + S[i][r]) for i in range(n)
+        ]) if S[:, r].sum() else _np.zeros((0,), _np.int64)
+        results.append(recv[k][idx])
+    return results
+
+
+def _rank_offset(mesh, axis: str, process_set=None) -> int:
+    """Global index of this process's first rank in the group."""
+    import jax as _jax
+    if _jax.process_count() == 1:
+        return 0
+    me = _jax.process_index()
+    for i, d in enumerate(mesh.devices.flat):
+        if d.process_index == me:
+            return i
+    return 0
 
 
 def reducescatter(x: Any, op: ReduceOp = Sum, process_set=None) -> Any:
@@ -210,10 +333,13 @@ def allreduce_async(x: Any, op: ReduceOp = Average, *,
 
 def allgather_async(x: Any, *, name: Optional[str] = None,
                     process_set=None) -> Handle:
+    if isinstance(x, (list, tuple)):
+        raise TypeError(
+            "ragged (Allgatherv) input is synchronous-only — it sequences "
+            "multiple negotiated collectives; call hvd.allgather(pieces)")
     entry = TensorTableEntry(
         name=_auto_name("allgather", name), verb="allgather",
-        payload=x if isinstance(x, (list, tuple)) else _C.as_per_rank(x, process_set),
-        process_set=process_set)
+        payload=_C.as_per_rank(x, process_set), process_set=process_set)
     return _engine().enqueue(entry)
 
 

@@ -74,10 +74,9 @@ class MetricAverageCallback(_Callback):
         if not keys:
             return
         values = np.asarray([float(logs[k]) for k in keys], np.float32)
-        reps = _hvd.local_size()
+        from horovod_tpu.ops.collectives import replicate_local
         averaged = _hvd.to_numpy(_hvd.allreduce(
-            _hvd.from_local(np.repeat(values[None], reps, axis=0)),
-            _hvd.Average))
+            replicate_local(values), _hvd.Average))
         for k, v in zip(keys, averaged):
             logs[k] = float(v)
 

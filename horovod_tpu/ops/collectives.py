@@ -196,6 +196,26 @@ def from_local(x: Any, process_set=None) -> jax.Array:
         (n,) + x.shape[1:], sharding, shards)
 
 
+def replicate_local(value: Any, process_set=None) -> jax.Array:
+    """Per-rank tensor where every rank this process drives holds the same
+    value (the single-process torch-bridge model: one process's tensor
+    stands for each of its devices).
+
+    One host→device transfer regardless of ``local_size``: the value is
+    staged to the first local device, then replicated device-to-device —
+    never ``local_size`` host-side copies of the payload.
+    """
+    mesh, axis = _mesh_axis(process_set)
+    arr = np.asarray(value)
+    n = mesh.shape[axis]
+    me = jax.process_index()
+    local_devs = [d for d in mesh.devices.flat if d.process_index == me]
+    first = jax.device_put(arr[None], local_devs[0])
+    shards = [first] + [jax.device_put(first, d) for d in local_devs[1:]]
+    return jax.make_array_from_single_device_arrays(
+        (n,) + arr.shape, _rank_sharding(mesh, axis), shards)
+
+
 def to_local(x: jax.Array) -> np.ndarray:
     """Rows of a per-rank result owned by this process's devices; replicated
     results return the single full copy (every local shard is identical)."""
@@ -470,7 +490,10 @@ def allgather(x: Any, process_set=None) -> jax.Array:
     """
     mesh, axis = _mesh_axis(process_set)
     if isinstance(x, (list, tuple)):
-        return _allgather_ragged(list(x), mesh, axis)
+        raise TypeError(
+            "ragged (Allgatherv) input is handled by horovod_tpu.allgather"
+            " — it composes negotiated uniform collectives (pad-to-max + "
+            "slice) so it stays correct in multi-process mode")
     x = as_per_rank(x, process_set)
     if x.ndim < 2:
         # scalar-per-rank gather == the per-rank vector itself, replicated
@@ -478,20 +501,6 @@ def allgather(x: Any, process_set=None) -> jax.Array:
     key = _sig(mesh, axis, "allgather", x.dtype.name, x.shape)
     fn = _cache.get_or_build(key, lambda: _build_allgather(mesh, axis))
     return fn(x)
-
-
-def _allgather_ragged(parts: list, mesh: Mesh, axis: str) -> jax.Array:
-    n = mesh.shape[axis]
-    if len(parts) != n:
-        raise ValueError(f"expected {n} per-rank pieces, got {len(parts)}")
-    arrs = [np.asarray(p) for p in parts]
-    trailing = {a.shape[1:] for a in arrs}
-    dtypes = {a.dtype for a in arrs}
-    if len(trailing) != 1 or len(dtypes) != 1:
-        raise ValueError("allgather pieces must agree on trailing dims/dtype")
-    # Single-controller: the concatenation is computed once and replicated.
-    out = np.concatenate(arrs, axis=0)
-    return jax.device_put(out, _replicated(mesh))
 
 
 def broadcast(x: Any, root_rank: int, process_set=None) -> jax.Array:
@@ -532,18 +541,11 @@ def alltoall(x: Any, splits: Optional[Sequence[int]] = None,
         fn = _cache.get_or_build(
             key, lambda: _build_alltoall(mesh, axis, rows // n))
         return fn(x)
-    splits = list(splits)
-    if len(splits) != n or sum(splits) != rows:
-        raise ValueError(
-            f"splits {splits} must have {n} entries summing to {rows}")
-    # Non-uniform: single-controller reassembly (exact, no padding waste);
-    # the compiled path above covers the uniform hot case (MoE dispatch).
-    host = to_numpy(x)
-    offs = np.concatenate([[0], np.cumsum(splits)])
-    pieces = [np.concatenate([host[src, offs[dst]:offs[dst + 1]]
-                              for src in range(n)], axis=0)
-              for dst in range(n)]
-    return [jax.device_put(p, _replicated(mesh)) for p in pieces]
+    raise TypeError(
+        "non-uniform (Alltoallv) splits are handled by "
+        "horovod_tpu.alltoall — it composes negotiated uniform "
+        "collectives (splits exchange + pad-to-max) so it stays correct "
+        "in multi-process mode")
 
 
 def reducescatter(x: Any, op: ReduceOp = ReduceOp.SUM,

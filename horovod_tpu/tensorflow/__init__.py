@@ -50,8 +50,10 @@ from horovod_tpu.ops.compression import Compression  # noqa: F401
 
 
 def _to_per_rank(arr: np.ndarray):
-    reps = _hvd.local_size()
-    return _hvd.from_local(np.repeat(arr[None], reps, axis=0))
+    # One host->device copy + on-device replication for local ranks (see
+    # replicate_local: never local_size host copies of the payload).
+    from horovod_tpu.ops.collectives import replicate_local
+    return replicate_local(arr)
 
 
 def _np(x) -> np.ndarray:

@@ -47,9 +47,12 @@ from horovod_tpu.ops.compression import Compression  # noqa: F401
 
 
 def _to_per_rank(t: torch.Tensor):
-    arr = t.detach().cpu().numpy()
-    reps = _hvd.local_size()
-    return _hvd.from_local(np.repeat(arr[None], reps, axis=0))
+    # One host->device copy per collective; on-device replication covers
+    # the process's other local ranks (never local_size host copies of
+    # the gradient bytes — on a real multi-chip host that would stage
+    # N x the payload through host memory per step).
+    from horovod_tpu.ops.collectives import replicate_local
+    return replicate_local(t.detach().cpu().numpy())
 
 
 def _from_result(x, like: torch.Tensor) -> torch.Tensor:
@@ -263,10 +266,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             if self._bpps > 1:
                 arr = arr / self._bpps
             import jax.numpy as jnp
+            from horovod_tpu.ops.collectives import replicate_local
             wire, ctx = self._compression.compress(jnp.asarray(arr))
             handle = _hvd.allreduce_async(
-                _hvd.from_local(np.repeat(np.asarray(wire)[None],
-                                          _hvd.local_size(), axis=0)),
+                replicate_local(np.asarray(wire)),
                 self.op, name=f"grad.{self._name_of(p)}")
             self._handles[p] = handle
             self._ctxs[p] = (ctx, grad.dtype)

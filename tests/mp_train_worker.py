@@ -58,6 +58,29 @@ def main() -> int:
     # 4. barrier
     hvd.barrier()
 
+    # 5. ragged allgather († MPI_Allgatherv): unequal row counts per rank,
+    # composed from negotiated uniform collectives (pad-to-max + slice).
+    rows = 2 + 3 * me
+    piece = (np.arange(rows * 2, dtype=np.float32).reshape(rows, 2)
+             + 100.0 * me)
+    got = hvd.to_numpy(hvd.allgather([piece]))
+    expected = np.concatenate([
+        np.arange((2 + 3 * r) * 2, dtype=np.float32).reshape(-1, 2) + 100.0 * r
+        for r in range(n)])
+    assert got.shape == expected.shape, (got.shape, expected.shape)
+    assert np.allclose(got, expected), (me, got, expected)
+
+    # 6. non-uniform alltoall († MPI_Alltoallv): per-rank splits differ.
+    my_splits = [1, 2] if me == 0 else [2, 1]
+    send = np.arange(3, dtype=np.float32) + 10.0 * me
+    recv = hvd.alltoall([send], splits=np.array([my_splits], np.int32))
+    # rank r receives splits_i[r] rows from each source i, source-ordered:
+    # rank0 gets send0[:1] + send1[:2]; rank1 gets send0[1:] + send1[2:].
+    want = (np.array([0.0, 10.0, 11.0], np.float32) if me == 0
+            else np.array([1.0, 2.0, 12.0], np.float32))
+    got_a2a = hvd.to_numpy(recv[0])
+    assert np.allclose(got_a2a, want), (me, got_a2a, want)
+
     hvd.shutdown()
 
     import json
