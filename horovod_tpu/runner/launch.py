@@ -75,6 +75,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--platform", default=None, choices=("tpu", "cpu"),
                    help="JAX platform workers select at init() "
                         "(cpu = the dev rig; default: auto)")
+    p.add_argument("--no-connectivity-check", action="store_true",
+                   default=False,
+                   help="skip the multi-host NIC discovery / connectivity "
+                        "probe stage († driver_service probe round)")
     p.add_argument("--verbose", "-v", action="store_true")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="program to run (e.g. python train.py)")
@@ -128,6 +132,7 @@ def launch_workers(command: Sequence[str], *, np_total: int,
                    ssh_port: int = 22,
                    verbose: bool = False,
                    prefix_output: bool = True,
+                   connectivity_check: bool = True,
                    failure_info: Optional[dict] = None) -> int:
     """Start services + workers; wait; return exit code.  Local ranks run as
     child processes, remote ranks through ``ssh`` († gloo_run exec path)."""
@@ -162,6 +167,31 @@ def launch_workers(command: Sequence[str], *, np_total: int,
     else:
         coord_port = pick_coordinator_port()
         coord_host = assignment[0][1]
+        if connectivity_check:
+            # NIC discovery + connectivity probe round († driver_service
+            # probe tasks): pick a driver address every host can actually
+            # reach and the coordinator host's peer-visible address,
+            # instead of trusting the default-route IP and DNS names.
+            try:
+                routing = _run_probe_stage(
+                    hosts, services, my_ip=my_ip, ssh_port=ssh_port,
+                    verbose=verbose)
+            except Exception as e:
+                # Any probe-stage failure must release the KV/controller
+                # servers and surface a named diagnosis, whatever the
+                # exception type (KV waits raise TimeoutError etc.).
+                services.close()
+                print(f"[launcher] connectivity check failed: {e}",
+                      file=sys.stderr)
+                raise
+            if routing["driver_addr"]:
+                services.service_ip = routing["driver_addr"]
+            coord_host = routing["host_addrs"].get(
+                assignment[0][1], coord_host)
+            if verbose:
+                print(f"[launcher] probe: driver={services.service_ip} "
+                      f"coordinator={coord_host} nics={routing['nics']}",
+                      file=sys.stderr)
 
     workers: List[_Worker] = []
     failed = threading.Event()
@@ -263,6 +293,57 @@ def launch_workers(command: Sequence[str], *, np_total: int,
         services.close()
 
 
+def _run_probe_stage(hosts, services, *, my_ip: str, ssh_port: int,
+                     verbose: bool = False) -> dict:
+    """Spawn one probe task per job host (ssh for remote, subprocess for
+    the driver's own host) and aggregate via :mod:`.probe`."""
+    from .probe import local_addresses, run_probe_stage
+    from .._native import KvClient
+
+    host_keys = []
+    for h in hosts:
+        if h.hostname not in host_keys:
+            host_keys.append(h.hostname)
+    candidates = ",".join(local_addresses())
+    kv_port = services.kv.port
+    secret = services.secret
+
+    def launch_fn(host: str) -> subprocess.Popen:
+        argv = [sys.executable, "-m", "horovod_tpu.runner.probe",
+                host, candidates, str(kv_port)]
+        if host in ("localhost", "127.0.0.1", my_ip):
+            env = dict(os.environ)
+            env["HVDTPU_SECRET"] = secret
+            return subprocess.Popen(argv, env=env,
+                                    stdout=subprocess.DEVNULL
+                                    if not verbose else None)
+        env_kv = " ".join(
+            f"{k}={shlex.quote(v)}" for k, v in os.environ.items()
+            if k != "HVDTPU_SECRET"
+            and k.startswith(("HVDTPU_", "PATH", "PYTHONPATH")))
+        remote = ("IFS= read -r HVDTPU_SECRET && export HVDTPU_SECRET && "
+                  f"cd {shlex.quote(os.getcwd())} && env {env_kv} "
+                  + " ".join(shlex.quote(c) for c in argv))
+        proc = subprocess.Popen(
+            ["ssh", "-p", str(ssh_port),
+             "-o", "StrictHostKeyChecking=no", host, remote],
+            stdin=subprocess.PIPE, text=True,
+            stdout=subprocess.DEVNULL if not verbose else None)
+        try:
+            assert proc.stdin is not None
+            proc.stdin.write(secret + "\n")
+            proc.stdin.close()
+        except (BrokenPipeError, OSError):
+            pass
+        return proc
+
+    kv = KvClient("127.0.0.1", kv_port, secret=secret)
+    try:
+        return run_probe_stage(host_keys, kv=kv, launch_fn=launch_fn)
+    finally:
+        kv.close()
+
+
 def _terminate(proc: subprocess.Popen) -> None:
     try:
         os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
@@ -331,7 +412,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     extra_env = _knob_env(args)
     return launch_workers(command, np_total=args.num_proc,
                           hosts_spec=args.hosts, extra_env=extra_env,
-                          ssh_port=args.ssh_port, verbose=args.verbose)
+                          ssh_port=args.ssh_port, verbose=args.verbose,
+                          connectivity_check=not args.no_connectivity_check)
 
 
 if __name__ == "__main__":

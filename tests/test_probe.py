@@ -1,0 +1,82 @@
+"""NIC discovery + connectivity probe stage († driver_service probe round,
+task_fn NIC registration).
+
+The probe protocol runs for real here — two probe tasks as genuine
+subprocesses against a live KV store — with "hosts" standing on
+localhost (the reference tests its driver service the same way: the
+protocol is pure TCP, host placement is ssh's job).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu._native import KvClient, KvServer
+from horovod_tpu.runner.probe import (
+    local_addresses,
+    run_probe_stage,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_local_addresses_nonempty_loopback_last():
+    addrs = local_addresses()
+    assert addrs, "no NIC addresses discovered"
+    if len(addrs) > 1:
+        assert not addrs[0].startswith("127."), addrs
+
+
+def _probe_proc(host_key: str, kv_port: int) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.probe",
+         host_key, "127.0.0.1", str(kv_port)],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+
+
+def test_probe_stage_end_to_end():
+    with KvServer() as srv:
+        kv = KvClient("127.0.0.1", srv.port)
+        result = run_probe_stage(
+            ["hostA", "hostB"],
+            kv=kv,
+            launch_fn=lambda h: _probe_proc(h, srv.port),
+            timeout=30.0)
+        kv.close()
+    # Both hosts are this machine: the agreed driver address is the one
+    # candidate offered, and each host was reached by its peer.
+    assert result["driver_addr"] == "127.0.0.1"
+    assert set(result["host_addrs"]) == {"hostA", "hostB"}
+    assert set(result["nics"]) == {"hostA", "hostB"}
+    for addrs in result["nics"].values():
+        assert addrs
+
+
+def test_probe_stage_reports_unregistered_host():
+    # Both probes are dead-on-arrival processes: no import-speed race, and
+    # the stage must name the first host that never registered.
+    with KvServer() as srv:
+        kv = KvClient("127.0.0.1", srv.port)
+
+        def launch_fn(h):
+            return subprocess.Popen(
+                [sys.executable, "-c", "import sys; sys.exit(3)"])
+
+        with pytest.raises(RuntimeError, match="hostBAD"):
+            run_probe_stage(["hostBAD", "hostB"], kv=kv,
+                            launch_fn=launch_fn, timeout=5.0)
+        kv.close()
+
+
+def test_probe_task_driver_unreachable():
+    # No KV server on this port: the task must fail fast with rc=3.
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.probe",
+         "hostX", "127.0.0.1", "1"],  # port 1: nothing listens
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    out, err = proc.communicate(timeout=30)
+    assert proc.returncode == 3, (proc.returncode, err)
+    assert "driver unreachable" in err
