@@ -103,7 +103,14 @@ def _labels_array(cols: dict, label_cols: Sequence[str]) -> np.ndarray:
 
 
 def _transform_frame(df, predict: Callable, output_col: str):
-    """Spark-style Transformer.transform: append the prediction column."""
+    """Spark-style Transformer.transform: append the prediction column.
+
+    A Spark DataFrame input is collected to pandas first (same collect
+    semantics as ``fit``); the returned frame is pandas either way.
+    """
+    from .store import _is_spark_dataframe
+    if _is_spark_dataframe(df):
+        df = df.toPandas()
     preds = predict(df)
     out = df.copy()
     out[output_col] = list(np.asarray(preds))

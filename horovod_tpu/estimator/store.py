@@ -68,14 +68,29 @@ def _read_parquet(path: str,
     return out
 
 
+def _is_spark_dataframe(obj: Any) -> bool:
+    """Structural check so Spark support needs no pyspark import here
+    (pyspark objects self-identify via their module path)."""
+    return ((type(obj).__module__ or "").startswith("pyspark")
+            and hasattr(obj, "toPandas"))
+
+
 def to_columns(data: Any,
                columns: Optional[Sequence[str]] = None
                ) -> dict[str, np.ndarray]:
     """Normalize ``data`` to ``{column: np.ndarray}`` with equal row counts.
 
-    Accepts a pandas DataFrame, a dict of array-likes, a structured numpy
-    array, or a path to a parquet file/directory.
+    Accepts a pandas DataFrame, a Spark DataFrame (column-pruned with
+    ``select`` then collected via ``toPandas`` — † the estimators'
+    ``fit(spark_df)`` surface; for datasets too large to collect,
+    materialize to parquet with ``df.write.parquet`` and pass the path,
+    the role Petastorm plays upstream), a dict of array-likes, a
+    structured numpy array, or a path to a parquet file/directory.
     """
+    if _is_spark_dataframe(data):
+        if columns is not None and hasattr(data, "select"):
+            data = data.select(list(columns))
+        data = data.toPandas()
     # Filter to the requested columns BEFORE conversion: an unrelated
     # ragged object column must not crash (or pay for) a fit that never
     # reads it.

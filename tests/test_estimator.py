@@ -80,6 +80,43 @@ class _Linear:
         return x @ params["w"] + params["b"]
 
 
+class _FakeSparkDataFrame:
+    """Spark DataFrame stand-in (pyspark is not in the image): the real
+    detection is structural — module path + toPandas — so this exercises
+    the exact code path a genuine pyspark DataFrame takes."""
+
+    def __init__(self, pdf):
+        self._pdf = pdf
+        self.select_calls = []
+
+    def select(self, cols):
+        self.select_calls.append(list(cols))
+        return _FakeSparkDataFrame(self._pdf[list(cols)])
+
+    def toPandas(self):
+        return self._pdf.copy()
+
+
+_FakeSparkDataFrame.__module__ = "pyspark.sql.dataframe"
+
+
+def test_spark_dataframe_ingestion_end_to_end():
+    """† horovod.spark estimators: fit/transform accept a Spark DataFrame
+    (column-pruned select -> toPandas collect -> the column path)."""
+    import optax
+    pdf = _regression_frame(128)
+    pdf["unrelated"] = [object()] * len(pdf)  # must be pruned, not crash
+    sdf = _FakeSparkDataFrame(pdf)
+    est = JaxEstimator(model=_Linear(), feature_cols=["features"],
+                       label_cols=["label"], loss="mse", batch_size=64,
+                       epochs=20, seed=0, optimizer=optax.adam(0.1))
+    fitted = est.fit(sdf)
+    assert fitted.history[-1]["loss"] < fitted.history[0]["loss"]
+    assert sdf.select_calls == [["features", "label"]]
+    out = fitted.transform(_FakeSparkDataFrame(pdf[["features"]]))
+    assert "prediction" in out.columns  # pandas result frame
+
+
 def test_jax_estimator_learns_regression():
     df = _regression_frame()
     import optax

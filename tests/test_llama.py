@@ -168,6 +168,26 @@ def test_fsdp_mixed_mesh_matches_dp_oracle():
     np.testing.assert_allclose(dp_losses, mixed_losses, rtol=1e-3)
 
 
+def test_fsdp_params_at_rest_are_sharded():
+    """ZeRO-3 memory property: every matmul weight (embed-dim params)
+    lives sharded over fsdp at rest — per-device bytes are 1/fsdp of the
+    leaf, not a full replica."""
+    mesh = build_mesh(MeshConfig(fsdp=8))
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), mesh)
+    for name in ("embed", "lm_head"):
+        leaf = params[name]
+        shard = leaf.addressable_shards[0].data
+        assert shard.size == leaf.size // 8, (
+            f"{name} not memory-sharded: shard {shard.shape} of {leaf.shape}")
+    for name in ("wq", "wo", "w_gate", "w_down"):
+        leaf = params["layers"][name]
+        shard = leaf.addressable_shards[0].data
+        assert shard.size == leaf.size // 8, (
+            f"layers/{name} not memory-sharded: "
+            f"shard {shard.shape} of {leaf.shape}")
+
+
 def test_fsdp_optimizer_state_is_sharded():
     # The ZeRO property: optimizer moments live sharded over fsdp, not
     # replicated — each device holds 1/fsdp of mu/nu for embed-dim params.
