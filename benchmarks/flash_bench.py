@@ -1,0 +1,119 @@
+"""Flash-vs-dense attention microbenchmark (the data behind the
+``ops/flash_attention.py`` speedup claims).
+
+Run on the target backend (TPU when the tunnel is up); appends one record
+per sequence length to ``benchmarks/measured.jsonl`` so every speedup
+number quoted in the tree points at committed data.
+
+Usage: python benchmarks/flash_bench.py [--seqs 1024 2048 4096] [--no-persist]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _fence(out) -> None:
+    # Host readback of one element: block_until_ready alone can be a no-op
+    # on tunneled backends (same caveat as bench.py), so force a
+    # device->host fetch, which cannot complete before the computation.
+    float(out.ravel()[0])
+
+
+def _time_it(fn, *args, iters: int = 50, warmup: int = 3) -> float:
+    for _ in range(warmup):
+        out = fn(*args)
+    _fence(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _fence(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _time_it_multi(fn, *args, iters: int = 50, warmup: int = 3) -> float:
+    """Same, for functions returning a tuple of arrays (grads)."""
+    for _ in range(warmup):
+        out = fn(*args)
+    _fence(out[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _fence(out[0])
+    return (time.perf_counter() - t0) / iters
+
+
+def run(seqs, persist: bool = True, causal: bool = True):
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops import flash_attention as fa
+
+    backend = jax.default_backend()
+    device_kind = getattr(jax.devices()[0], "device_kind", backend)
+    B, H, D = 4, 16, 64
+    scale = D ** -0.5
+    records = []
+    for S in seqs:
+        key = jax.random.PRNGKey(S)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (B, S, H, D), jnp.bfloat16)
+        k = jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+        v = jax.random.normal(kv, (B, S, H, D), jnp.bfloat16)
+
+        dense = jax.jit(lambda q, k, v: fa.dense_attention(
+            q, k, v, scale, causal))
+        flash = jax.jit(lambda q, k, v: fa.flash_attention(
+            q, k, v, causal=causal))
+        # Training shape: forward + backward through the attention (what
+        # the flagship's train step actually pays — the flash backward
+        # recomputes score blocks instead of materializing the [S, S]
+        # softmax residuals the dense VJP hauls through HBM).
+        dense_vg = jax.jit(jax.grad(lambda q, k, v: fa.dense_attention(
+            q, k, v, scale, causal).astype(jnp.float32).sum(), (0, 1, 2)))
+        flash_vg = jax.jit(jax.grad(lambda q, k, v: fa.flash_attention(
+            q, k, v, causal=causal).astype(jnp.float32).sum(), (0, 1, 2)))
+
+        t_dense = _time_it(dense, q, k, v)
+        t_flash = _time_it(flash, q, k, v)
+        t_dense_vg = _time_it_multi(dense_vg, q, k, v)
+        t_flash_vg = _time_it_multi(flash_vg, q, k, v)
+        rec = {
+            "metric": f"flash_attention_speedup_{backend}",
+            "seq_len": S, "B": B, "H": H, "D": D, "dtype": "bfloat16",
+            "causal": causal,
+            "fwd": {"dense_ms": round(t_dense * 1e3, 3),
+                    "flash_ms": round(t_flash * 1e3, 3),
+                    "speedup": round(t_dense / t_flash, 2)},
+            "fwd_bwd": {"dense_ms": round(t_dense_vg * 1e3, 3),
+                        "flash_ms": round(t_flash_vg * 1e3, 3),
+                        "speedup": round(t_dense_vg / t_flash_vg, 2)},
+            "device_kind": device_kind, "ts": time.time(),
+        }
+        records.append(rec)
+        print(json.dumps(rec))
+    if persist:
+        with open(os.path.join(REPO, "benchmarks", "measured.jsonl"),
+                  "a") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+    return records
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", type=int, nargs="+",
+                    default=[1024, 2048, 4096])
+    ap.add_argument("--no-persist", action="store_true")
+    ap.add_argument("--non-causal", action="store_true")
+    args = ap.parse_args()
+    run(args.seqs, persist=not args.no_persist,
+        causal=not args.non_causal)

@@ -18,8 +18,11 @@ Layout choices (see /opt/skills/guides/pallas_guide.md):
   ``preferred_element_type=jnp.float32`` so the MXU accumulates fp32 while
   inputs stay bf16.
 
-Measured on TPU v5-lite vs XLA's fused dense attention (fwd, bf16,
-B=4,H=16,D=64): 1.1x at S=1024, 1.6x at 2048, 5.7x at 4096.
+Measured on TPU v5 lite vs XLA's fused dense attention (bf16,
+B=4,H=16,D=64, causal), forward+backward — the training shape: 1.06x at
+S=512, 1.57x at 1024, 2.31x at 2048, 4.74x at 4096 (forward alone: 1.18x /
+1.28x / 1.89x / 6.85x).  Data committed in ``benchmarks/measured.jsonl``;
+reproduce with ``python benchmarks/flash_bench.py``.
 """
 
 from __future__ import annotations
@@ -300,9 +303,9 @@ def _flash_backward(q, k, v, out, lse, g, *, scale, causal, block_q,
 # ---------------------------------------------------------------------------
 
 def default_blocks(seq_len: int) -> tuple[int, int]:
-    """Measured on v5-lite: large query blocks amortize per-program cost
-    (bq=512/bk=1024 beat XLA's fused dense attention from S=1024 up,
-    5.7x at S=4096)."""
+    """Large query blocks amortize per-program cost; bq=512/bk=1024 gave
+    the best measured times on TPU v5 lite (data in
+    benchmarks/measured.jsonl)."""
     bq = next((b for b in (512, 256, 128) if seq_len % b == 0), None)
     bk = next((b for b in (1024, 512, 256, 128) if seq_len % b == 0), None)
     return bq or 128, bk or 128
@@ -310,10 +313,12 @@ def default_blocks(seq_len: int) -> tuple[int, int]:
 
 def supported(q_shape: tuple, itemsize: int = 4) -> bool:
     """Shapes the kernel handles: seq divisible by a block size, D ≤ 256,
-    and the heaviest kernel's resident set fitting VMEM.  The budget counts
-    what actually sits in VMEM at once: two full-sequence operands (K/V in
-    the forward, Q/dO in the dkv backward), the lse/delta rows, and the
-    double-buffered fp32 block operands/accumulators."""
+    and the heaviest kernel's resident set fitting VMEM (measured fwd+bwd
+    speedup over dense is ≥1x at every supported length — see module
+    docstring).  The budget counts what actually sits in VMEM at once:
+    two full-sequence operands (K/V in the forward, Q/dO in the dkv
+    backward), the lse/delta rows, and the double-buffered fp32 block
+    operands/accumulators."""
     B, S, H, D = q_shape
     bq, bk = default_blocks(S)
     blk = max(bq, bk)
