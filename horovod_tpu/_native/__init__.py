@@ -21,6 +21,9 @@ class NegotiationResult(NamedTuple):
     ``stalled``: names some ranks submitted but others haven't (stall warn).
     ``metas``: name → opaque descriptor for ready tensors (used by joined
     ranks to build zero-payload participation).
+    ``join_covered``: names whose readiness depended on a joined rank's
+    fabricated zero participation — only allreduce may dispatch for these
+    († the reference errors non-allreduce ops while any rank is joined).
     ``all_joined`` / ``last_join_rank``: † ``hvd.join()`` completion signal.
     """
     ready: list
@@ -28,6 +31,7 @@ class NegotiationResult(NamedTuple):
     metas: dict
     all_joined: bool
     last_join_rank: int
+    join_covered: frozenset = frozenset()
 
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 _REPO_ROOT = os.path.dirname(os.path.dirname(_PKG_DIR))
@@ -41,15 +45,15 @@ def _so_path() -> str:
     """Locate (or build) the native core.
 
     Search order: the source tree's ``native/`` when present (dev and
-    editable installs — built on demand with make, and always current),
-    else a wheel-shipped copy next to this package
-    († ``basics.py`` loading the built extension).
+    editable installs), else a wheel-shipped copy next to this package
+    († ``basics.py`` loading the built extension).  make runs on every
+    source-tree load — a no-op when the .so is newer than the sources —
+    so editing ``hvdtpu_core.cc`` never silently loads a stale binary.
     """
     if os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
         src_so = os.path.join(_NATIVE_DIR, "libhvdtpu_core.so")
-        if not os.path.exists(src_so):
-            subprocess.run(["make", "-C", _NATIVE_DIR],
-                           check=True, capture_output=True)
+        subprocess.run(["make", "-C", _NATIVE_DIR],
+                       check=True, capture_output=True)
         return src_so
     wheel_so = os.path.join(_PKG_DIR, "libhvdtpu_core.so")
     if os.path.exists(wheel_so):
@@ -270,17 +274,22 @@ class ControllerClient:
             raise RuntimeError(f"negotiation response {n} bytes exceeds cap")
         payload = buf.raw[:n].decode()
         ready_part, _, stalled_part = payload.partition("\x01")
-        ready, metas = [], {}
+        ready, metas, covered = [], {}, set()
         for item in ready_part.split("\n"):
             if not item:
                 continue
-            name, _, meta = item.partition("\x02")
+            parts = item.split("\x02")
+            name = parts[0]
+            meta = parts[1] if len(parts) > 1 else ""
             ready.append(name)
             if meta:
                 metas[name] = meta
+            if len(parts) > 2 and parts[2] == "j":
+                covered.add(name)
         stalled = [s for s in stalled_part.split("\n") if s]
         return NegotiationResult(ready, stalled, metas,
-                                 bool(all_joined.value), last_rank.value)
+                                 bool(all_joined.value), last_rank.value,
+                                 frozenset(covered))
 
     @property
     def cache_size(self) -> int:

@@ -92,6 +92,34 @@ class TensorTableEntry:
         return json.dumps(m, separators=(",", ":"))
 
 
+def _joinable_entry(e: TensorTableEntry) -> bool:
+    """Can a joined rank stand in for this entry with zeros?
+
+    † Reference join semantics: allreduce (and its grouped/fused form)
+    only.  Process-set entries and entries whose descriptor cannot be
+    serialized (ragged payloads) are excluded — the joined rank could not
+    rebuild them.  Must agree with :func:`_parse_joinable_meta`: live
+    ranks decide from their own entry, joined ranks from the echoed meta,
+    and both must reach the same verdict for the mesh to stay consistent.
+    """
+    return (e.verb == "allreduce" and e.process_set is None
+            and e.meta() != "")
+
+
+def _parse_joinable_meta(meta: str) -> Optional[dict]:
+    """Parse an echoed descriptor; None unless it describes a joinable
+    (allreduce) entry.  The joined-rank half of :func:`_joinable_entry`."""
+    if not meta:
+        return None
+    try:
+        m = json.loads(meta)
+    except ValueError:
+        return None
+    if m.get("v") != "allreduce":
+        return None
+    return m
+
+
 class Handle:
     """Async completion handle († ``handle_manager.cc``: int handle +
     ``synchronize``)."""
@@ -132,6 +160,11 @@ class NegotiationOutcome:
     ``ready``: globally-ready names in the agreed dispatch order.
     ``metas``: name → serialized entry descriptor for ready tensors this
     process may not hold locally (join zero-participation).
+    ``join_covered``: ready names whose readiness depended on a joined
+    rank's fabricated zero participation — only allreduce dispatches for
+    these; other verbs error identically on every rank († the reference
+    returns an error Response for non-allreduce ops while a rank is
+    joined).
     ``all_joined`` / ``last_join_rank``: † ``hvd.join()`` completion.
     """
     ready: list[str]
@@ -139,6 +172,7 @@ class NegotiationOutcome:
     metas: dict = field(default_factory=dict)
     all_joined: bool = False
     last_join_rank: int = 0
+    join_covered: set = field(default_factory=set)
 
 
 class Negotiator:
@@ -191,6 +225,11 @@ class CollectiveEngine:
         self._join_requested = False
         self._join_result = -1
         self._join_event = threading.Event()
+        # Latched completion: set by the engine when a join finishes with
+        # no caller waiting (the caller timed out); consumed by the next
+        # join() call so it returns the delivered result instead of
+        # re-raising the JOIN flag into a new phase.
+        self._join_pending_consume = False
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -345,6 +384,7 @@ class CollectiveEngine:
                 with self._lock:
                     self._join_requested = False
                     self._join_result = -1
+                    self._join_pending_consume = True
                 self._join_event.set()
             log.error("negotiation failed; %d collectives errored: %s",
                       len(batch), err)
@@ -353,25 +393,40 @@ class CollectiveEngine:
         ready: list[TensorTableEntry] = []
         for name in outcome.ready:
             e = by_name.get(name)
-            if e is None and join_req:
-                # Not ours: another rank's tensor became ready because we
-                # joined — participate with zeros († JoinOp).  If zeros
-                # cannot be built (no/unusable metadata), fail the join
-                # loudly: the alternative is a silent mesh-wide hang while
-                # the live ranks wait for our dispatch.
-                e = self._zero_entry(name, outcome.metas.get(name, ""))
-                if e is None:
-                    with self._lock:
-                        self._join_requested = False
-                        self._join_result = -1
-                    self._join_event.set()
-                    log.error(
-                        "join() aborted: cannot zero-participate in ready "
-                        "tensor %r (process-set or ragged collectives are "
-                        "not joinable)", name)
-                    continue
-                handles[id(e)] = Handle(e.name)  # result dropped
             if e is not None:
+                if name in outcome.join_covered and not _joinable_entry(e):
+                    # † Join supports allreduce only: a joined rank cannot
+                    # fabricate meaningful participation in an allgather /
+                    # broadcast / alltoall (zero rows would silently corrupt
+                    # the result), so every rank errors this entry instead
+                    # of dispatching.  The joined rank skips it by the same
+                    # rule (below), keeping the mesh consistent — no hang.
+                    with self._lock:
+                        self._names_pending.discard(e.name)
+                    self._tl_close(e)
+                    handles[id(e)]._complete(error=HorovodInternalError(
+                        f"collective {name!r} ({e.verb}"
+                        + (", process-set" if e.process_set is not None
+                           else "")
+                        + ") became ready through a joined rank, but only "
+                        "allreduce supports join zero-participation "
+                        "(† reference join semantics)"))
+                    continue
+                ready.append(e)
+            elif join_req:
+                # Not ours: another rank's tensor became ready because we
+                # joined — participate with zeros († JoinOp) when the verb
+                # allows it.  Non-joinable entries are skipped here and
+                # error on the ranks that own them (same rule, so nobody
+                # dispatches and nobody hangs).
+                meta = _parse_joinable_meta(outcome.metas.get(name, ""))
+                if meta is None:
+                    log.warning(
+                        "join: skipping non-joinable ready tensor %r "
+                        "(it errors on the ranks that submitted it)", name)
+                    continue
+                e = self._zero_entry(name, meta)
+                handles[id(e)] = Handle(e.name)  # result dropped
                 ready.append(e)
         ready_ids = {id(e) for e in ready}
         deferred = [(e, h) for e, h in batch if id(e) not in ready_ids]
@@ -384,6 +439,7 @@ class CollectiveEngine:
             with self._lock:
                 self._join_requested = False
                 self._join_result = outcome.last_join_rank
+                self._join_pending_consume = True
             self._join_event.set()
         if self._autotuner is not None:
             payload = sum(self._entry_bytes(e) for e in ready)
@@ -399,23 +455,33 @@ class CollectiveEngine:
                 "engine.join() requires distributed (multi-process) mode; "
                 "single-controller callers use the barrier fallback")
         deadline = None if timeout is None else time.monotonic() + timeout
-        # Drain our own pending collectives first: a joining rank has no
-        # more inputs, so everything already enqueued must dispatch before
-        # the JOIN flag is raised (matching the reference, where JOIN is
-        # itself a queued request ordered after prior submissions).
-        while True:
-            with self._lock:
-                if not self._queue and not self._names_pending:
-                    break
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError("join(): pending collectives never drained")
-            self.nudge()
-            time.sleep(0.005)
-        self._join_event.clear()
-        with self._wake:
-            self._join_requested = True
-            self._urgent = True
-            self._wake.notify_all()
+        with self._lock:
+            if self._join_pending_consume:
+                # A previous join() timed out but the join completed while
+                # no caller was waiting; hand over the latched result
+                # instead of enrolling this rank in a brand-new join phase.
+                return self._consume_join_locked()
+            resuming = self._join_requested
+        if not resuming:
+            # Drain our own pending collectives first: a joining rank has
+            # no more inputs, so everything already enqueued must dispatch
+            # before the JOIN flag is raised (matching the reference,
+            # where JOIN is itself a queued request ordered after prior
+            # submissions).
+            while True:
+                with self._lock:
+                    if not self._queue and not self._names_pending:
+                        break
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "join(): pending collectives never drained")
+                self.nudge()
+                time.sleep(0.005)
+            self._join_event.clear()
+            with self._wake:
+                self._join_requested = True
+                self._urgent = True
+                self._wake.notify_all()
         remaining = None if deadline is None else \
             max(0.0, deadline - time.monotonic())
         if not self._join_event.wait(remaining):
@@ -424,41 +490,42 @@ class CollectiveEngine:
             # implicit coverage), so the engine MUST stay in joined mode
             # and keep zero-participating; clearing the flag here would
             # strand the other ranks mid-collective.  The caller may
-            # re-invoke join() to resume waiting — server-side join state
-            # is idempotent and a joined rank may even submit new tensors
-            # consistently (coverage is a union).
+            # re-invoke join() to resume waiting — it resumes this join
+            # phase (or consumes the result if it completed meanwhile)
+            # rather than starting a new one.
             raise TimeoutError(
                 "join(): not all ranks joined in time (this rank remains "
                 "joined; call join() again to keep waiting)")
-        if self._join_result < 0:
-            raise HorovodInternalError("join(): failed mid-join (see log)")
-        return self._join_result
+        with self._lock:
+            return self._consume_join_locked()
 
-    def _zero_entry(self, name: str, meta: str
-                    ) -> Optional[TensorTableEntry]:
+    def _consume_join_locked(self) -> int:
+        """Hand the completed join result to the caller (lock held)."""
+        self._join_pending_consume = False
+        result = self._join_result
+        self._join_result = -1
+        self._join_event.clear()
+        if result < 0:
+            raise HorovodInternalError("join(): failed mid-join (see log)")
+        return result
+
+    def _zero_entry(self, name: str, m: dict) -> TensorTableEntry:
         """Build the zero-payload stand-in a joined rank contributes.
 
         † JoinOp semantics: the joined rank supplies zeros of the same
         shape/dtype; AVERAGE divides by the full world size including
-        joined ranks (reference behavior).
+        joined ranks (reference behavior).  ``m`` is a descriptor already
+        validated by :func:`_parse_joinable_meta`, so construction cannot
+        fail on verb/shape grounds; dtype resolution goes through jnp so
+        extended types (bfloat16, fp8) work.
         """
-        if not meta:
-            log.warning(
-                "join: tensor %r ready without metadata; cannot zero-"
-                "participate (process-set or ragged collective)", name)
-            return None
+        import jax.numpy as jnp
         import numpy as np
-        try:
-            m = json.loads(meta)
-            shape = tuple(m["s"])
-            local_rows = len(self._state.local_devices)
-            zeros = np.zeros((local_rows,) + shape[1:],
-                             dtype=np.dtype(m["d"]))
-            payload = C.from_local(zeros)
-        except Exception as err:
-            log.error("join: failed to build zero entry for %r: %s",
-                      name, err)
-            return None
+        shape = tuple(m["s"])
+        local_rows = len(self._state.local_devices)
+        zeros = np.zeros((local_rows,) + shape[1:],
+                         dtype=jnp.dtype(m["d"]))
+        payload = C.from_local(zeros)
         return TensorTableEntry(
             name=name, verb=m["v"], payload=payload,
             op=C.ReduceOp(m["o"]), root_rank=m.get("r", 0),

@@ -48,7 +48,8 @@ class DistributedNegotiator(Negotiator):
         # local entries (or join zero-participation for names it lacks).
         return NegotiationOutcome(
             ready=res.ready, stalled=res.stalled, metas=res.metas,
-            all_joined=res.all_joined, last_join_rank=res.last_join_rank)
+            all_joined=res.all_joined, last_join_rank=res.last_join_rank,
+            join_covered=set(res.join_covered))
 
     def close(self) -> None:
         self._client.close()

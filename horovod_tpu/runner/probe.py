@@ -167,8 +167,8 @@ def probe_task(host_key: str, driver_candidates: List[str], kv_port: int,
 
 def run_probe_stage(host_keys: List[str], *, kv, launch_fn,
                     timeout: float = 60.0) -> dict:
-    """Driver half: launch a probe on every host via ``launch_fn(host,
-    argv) -> Popen``, aggregate registrations, and return the routing
+    """Driver half: launch a probe on every host via ``launch_fn(host)
+    -> Popen``, aggregate registrations, and return the routing
     decisions.
 
     Returns ``{"driver_addr": addr reachable from every host,

@@ -720,10 +720,14 @@ class Controller {
       by_name_[name] = id;
     } else {
       TensorState& st = tensors_[it->second];
-      // A resubmission carrying meta refreshes it (clients bypass the id
-      // fast path when a tensor's descriptor changes, e.g. a tail batch
-      // with a different shape — joined ranks need the current one).
-      if (!meta.empty()) st.meta = meta;
+      // A name ('N') resubmission carries the entry's current meta and
+      // replaces the stored one, including replacing it with "" (clients
+      // bypass the id fast path whenever a tensor's descriptor changes,
+      // e.g. a tail batch with a new shape, or a name reused for a
+      // non-joinable collective).  Keeping the echoed meta identical to
+      // what the submitting ranks hold this round is what lets joined and
+      // live ranks agree on joinability.
+      st.meta = meta;
       Touch(st, rank);
     }
   }
@@ -780,6 +784,20 @@ class Controller {
       put_u32(&resp, st->id);
       put_str(&resp, st->name);
       put_str(&resp, st->meta);
+      // Join-coverage flag: 1 when some joined rank never submitted this
+      // tensor, i.e. readiness depends on fabricated zero participation.
+      // Ranks use it to error non-joinable verbs consistently everywhere
+      // († the reference returns an error Response for non-allreduce ops
+      // while any rank is joined) instead of dispatching a collective the
+      // joined rank cannot take part in.
+      uint8_t cov = 0;
+      for (uint32_t jr : joined_) {
+        if (!st->ranks_seen.count(jr)) {
+          cov = 1;
+          break;
+        }
+      }
+      resp += static_cast<char>(cov);
       const_cast<TensorState*>(st)->ranks_seen.clear();
     }
     put_u32(&resp, static_cast<uint32_t>(stalled.size()));
@@ -837,14 +855,20 @@ class CtrlClient {
   }
   bool ok() const { return fd_ >= 0; }
 
+  struct ReadyItem {
+    std::string name;
+    std::string meta;
+    bool join_cov;  // readiness depended on a joined rank's zero coverage
+  };
+
   // entries: (name, meta) for the tensors pending on this rank this round
   // (meta travels only on first sighting; cached names go as ids).
   // joined: this rank has no more inputs († RequestType::JOIN).
   // Returns the agreed globally-ready ordered list with each tensor's
-  // meta, plus the all-joined signal.
+  // meta + join-coverage flag, plus the all-joined signal.
   bool Negotiate(const std::vector<std::pair<std::string, std::string>>& entries,
                  bool joined,
-                 std::vector<std::pair<std::string, std::string>>* ready,
+                 std::vector<ReadyItem>* ready,
                  std::vector<std::string>* stalled, bool* all_joined,
                  uint32_t* last_join_rank) {
     std::string msg;
@@ -877,8 +901,9 @@ class CtrlClient {
       uint32_t id = get_u32(reply, &off);
       std::string nm = get_str(reply, &off);
       std::string meta = get_str(reply, &off);
+      bool cov = reply[off++] != 0;
       cache_[nm] = id;
-      ready->emplace_back(std::move(nm), std::move(meta));
+      ready->push_back({std::move(nm), std::move(meta), cov});
     }
     uint32_t n_stalled = get_u32(reply, &off);
     stalled->clear();
@@ -982,9 +1007,11 @@ void* hvd_ctrl_connect(const char* host, int port, int rank, int timeout_ms,
 
 // names_blob: '\n'-joined entries ('' = none), each "name" or
 // "name\x02meta".  joined: nonzero when this rank has JOINed.  On success
-// writes '\n'-joined ready entries ("name\x02meta") then '\x01' then
-// '\n'-joined stalled names into out, sets *all_joined / *last_join_rank,
-// and returns total length (or required length if > cap; -1 on failure).
+// writes '\n'-joined ready entries ("name\x02meta", with "\x02j" appended
+// when readiness depended on a joined rank's zero coverage) then '\x01'
+// then '\n'-joined stalled names into out, sets *all_joined /
+// *last_join_rank, and returns total length (or required length if > cap;
+// -1 on failure).
 int hvd_ctrl_negotiate(void* c, const char* names_blob, int joined_flag,
                        char* out, int cap, int* all_joined,
                        int* last_join_rank) {
@@ -1007,7 +1034,7 @@ int hvd_ctrl_negotiate(void* c, const char* names_blob, int joined_flag,
       start = nl + 1;
     }
   }
-  std::vector<std::pair<std::string, std::string>> ready;
+  std::vector<CtrlClient::ReadyItem> ready;
   std::vector<std::string> stalled;
   bool aj = false;
   uint32_t last = 0;
@@ -1019,9 +1046,10 @@ int hvd_ctrl_negotiate(void* c, const char* names_blob, int joined_flag,
   std::string joined;
   for (size_t i = 0; i < ready.size(); ++i) {
     if (i) joined += '\n';
-    joined += ready[i].first;
+    joined += ready[i].name;
     joined += '\x02';
-    joined += ready[i].second;
+    joined += ready[i].meta;
+    if (ready[i].join_cov) joined += "\x02j";
   }
   joined += '\x01';
   for (size_t i = 0; i < stalled.size(); ++i) {

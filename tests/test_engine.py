@@ -160,6 +160,85 @@ def test_timeline_engine_phases(tmp_path):
         < spans.index("DISPATCH")
 
 
+def test_join_covered_non_allreduce_errors():
+    """A non-allreduce collective whose readiness depended on a joined
+    rank's fabricated zeros must error on the ranks that own it — zeros in
+    an allgather/broadcast would silently corrupt the result (advisor
+    finding; † the reference errors non-allreduce ops during join)."""
+    from horovod_tpu.ops.engine import NegotiationOutcome, Negotiator
+
+    class CoveredNegotiator(Negotiator):
+        always_check_in = False
+
+        def negotiate(self, entries, *, joined=False):
+            names = [e.name for e in entries]
+            return NegotiationOutcome(ready=names, join_covered=set(names))
+
+    eng = hvd.global_state().engine
+    old = eng._negotiator
+    eng._negotiator = CoveredNegotiator()
+    try:
+        x = hvd.per_rank([np.ones((2,), np.float32)] * N)
+        h = hvd.allgather_async(x, name="t.cov.ag")
+        with pytest.raises(hvd.HorovodInternalError, match="allreduce"):
+            hvd.synchronize(h)
+        hb = hvd.broadcast_async(x, 0, name="t.cov.bc")
+        with pytest.raises(hvd.HorovodInternalError, match="allreduce"):
+            hvd.synchronize(hb)
+        # allreduce itself is joinable and must still complete.
+        h2 = hvd.allreduce_async(x, hvd.Sum, name="t.cov.ar")
+        np.testing.assert_allclose(hvd.to_numpy(hvd.synchronize(h2)),
+                                   np.full((2,), float(N)))
+    finally:
+        eng._negotiator = old
+
+
+def test_join_timeout_then_latched_result():
+    """join() timing out must leave the rank joined; once the join
+    completes with no waiter, the next join() call consumes the latched
+    result instead of enrolling in a new join phase (advisor finding)."""
+    from horovod_tpu.ops.engine import NegotiationOutcome, Negotiator
+
+    class SlowJoinNegotiator(Negotiator):
+        always_check_in = True   # cycles run even with an empty queue
+
+        def __init__(self):
+            self.joined_rounds = 0
+
+        def negotiate(self, entries, *, joined=False):
+            names = [e.name for e in entries]
+            if joined:
+                self.joined_rounds += 1
+                if self.joined_rounds >= 3:
+                    return NegotiationOutcome(
+                        ready=names, all_joined=True, last_join_rank=5)
+                # A ghost tensor owned by another (live) rank that is NOT
+                # joinable: the joined engine must skip it (the owner
+                # errors it via join_covered) rather than crash or abort.
+                return NegotiationOutcome(
+                    ready=names + ["t.ghost.ag"],
+                    metas={"t.ghost.ag": '{"v":"allgather",'
+                           '"d":"float32","s":[8,2],"o":"sum"}'},
+                    join_covered={"t.ghost.ag"})
+            return NegotiationOutcome(ready=names)
+
+    eng = hvd.global_state().engine
+    old = eng._negotiator
+    eng._negotiator = SlowJoinNegotiator()
+    try:
+        with pytest.raises(TimeoutError):
+            eng.join(timeout=1e-4)
+        deadline = time.monotonic() + 10
+        while not eng._join_pending_consume and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert eng.join(timeout=5) == 5
+        # State fully consumed: no stale result for a future phase.
+        assert not eng._join_pending_consume
+        assert not eng._join_requested
+    finally:
+        eng._negotiator = old
+
+
 def test_negotiator_failure_fails_handles():
     """A negotiation transport failure must error every pending handle
     rather than hanging waiters (code-review finding)."""

@@ -313,6 +313,59 @@ def test_join_all_joined_reports_last_rank():
             c.close()
 
 
+def test_join_coverage_flag_marks_fabricated_readiness():
+    """A tensor ready only because a joined rank implicitly covers it must
+    carry the join-coverage flag on every rank — the signal engines use to
+    error non-allreduce verbs consistently († the reference errors
+    non-allreduce ops while any rank is joined)."""
+    with ControllerServer(size=2) as srv:
+        clients = [ControllerClient("127.0.0.1", srv.port, r)
+                   for r in range(2)]
+        out = _round(clients, {0: [("grad.c", '{"v":"allreduce"}')]},
+                     joined={1})
+        for r in range(2):
+            assert out[r].ready == ["grad.c"]
+            assert "grad.c" in out[r].join_covered
+        for c in clients:
+            c.close()
+
+
+def test_join_coverage_flag_absent_when_all_submit():
+    """A joined rank that still submits a tensor provides real (not
+    fabricated) participation, so the coverage flag must stay clear."""
+    with ControllerServer(size=2) as srv:
+        clients = [ControllerClient("127.0.0.1", srv.port, r)
+                   for r in range(2)]
+        out = _round(clients, {0: [("t.real", "")], 1: [("t.real", "")]},
+                     joined={1})
+        for r in range(2):
+            assert out[r].ready == ["t.real"]
+            assert out[r].join_covered == frozenset()
+        for c in clients:
+            c.close()
+
+
+def test_join_meta_cleared_by_empty_resubmission():
+    """An 'N' resubmission carrying an empty meta must replace the stored
+    one — live and joined ranks decide joinability from the same
+    descriptor, so a stale non-empty meta would split the mesh."""
+    with ControllerServer(size=2) as srv:
+        clients = [ControllerClient("127.0.0.1", srv.port, r)
+                   for r in range(2)]
+        subs = {r: [("t.m", '{"v":"allreduce"}')] for r in range(2)}
+        out = _round(clients, subs)
+        assert out[0].metas.get("t.m") == '{"v":"allreduce"}'
+        # Same name resubmitted with empty meta (e.g. now a process-set
+        # entry): the echoed meta must be empty, not the stale allreduce
+        # descriptor.
+        subs = {r: [("t.m", "")] for r in range(2)}
+        out = _round(clients, subs)
+        assert out[0].ready == ["t.m"]
+        assert "t.m" not in out[0].metas
+        for c in clients:
+            c.close()
+
+
 def test_join_metadata_survives_cache_fast_path():
     # Meta travels on first sighting; later id-cached rounds must still
     # deliver it to a rank that joins afterwards.
