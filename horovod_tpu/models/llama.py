@@ -94,7 +94,7 @@ def param_logical_dims(cfg: LlamaConfig) -> dict:
             "w_down": ("stage", "mlp", "embed"),
         })
     return {
-        "embed": ("vocab", "embed"),
+        "embed": ("vocab_rows", None),
         "layers": layer,
         "final_norm": (None,),
         "lm_head": ("embed", "vocab"),
@@ -253,10 +253,17 @@ def _moe_mlp(h2, lp, cfg: LlamaConfig, mesh: Optional[Mesh]):
                "w_down": lp["w_down"]}
     ep = mesh.shape.get("ep", 1) if mesh is not None else 1
     if ep > 1:
+        # Expert buffers lose their token dim when built, so on the axes
+        # that stay automatic inside this shard_map (dp/fsdp/tp) they are
+        # replicated; pin that so the propagator can't smear batch
+        # shardings onto the expert dim of saved-for-backward buffers.
+        repl = NamedSharding(mesh, P())
         fn = shard_map(
             lambda tok, rk, pr: moe_layer_local(
                 tok, rk, expert_fn, pr, axis_name="ep",
-                capacity_factor=cfg.capacity_factor),
+                capacity_factor=cfg.capacity_factor,
+                buffer_constraint=lambda x:
+                    jax.lax.with_sharding_constraint(x, repl)),
             mesh=mesh,
             in_specs=(P("ep"), P(), P("ep")),
             out_specs=(P("ep"), P()),
@@ -368,9 +375,20 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, *,
     h = params["embed"].astype(cfg.dtype)[tokens]           # [B,S,D]
     h = shd.constrain(h, ("batch", "seq", None), mesh) if mesh else h
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    if mesh is not None:
+        # Per-layer rule shardings for the scanned slices (leading "stage"
+        # dim dropped).  Pinning the slices inside the body stops GSPMD's
+        # propagator from deriving batch-flavored shardings for loop-body
+        # weights — the source of "involuntary full rematerialization"
+        # resharding on every layer (round-2 verdict finding).
+        layer_dims = {k: d[1:]
+                      for k, d in param_logical_dims(cfg)["layers"].items()}
 
     def layer_body(carry, lp):
         h, aux = carry
+        if mesh is not None:
+            lp = {k: shd.constrain(v, layer_dims[k], mesh)
+                  for k, v in lp.items()}
         h = _attn_block(h, lp, positions, cfg,
                         lambda q, k, v: _attention(q, k, v, mesh, causal))
         x2 = _rmsnorm(h, lp["mlp_norm"])
@@ -417,6 +435,11 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx):
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(
             lambda p: loss_fn(p, batch, cfg, mesh=mesh))(params)
+        # Pin gradients to the parameter shardings: the backward scan's
+        # per-layer dynamic-update-slice accumulators otherwise get
+        # propagation-derived shardings that force involuntary full
+        # rematerialization on the way into the optimizer update.
+        grads = jax.lax.with_sharding_constraint(grads, pshard)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = jax.tree.map(jnp.add, params, updates)
         return params, opt_state, loss

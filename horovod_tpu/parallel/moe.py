@@ -55,13 +55,22 @@ def moe_layer_local(tokens: jax.Array,
                     expert_fn: Callable[[Any, jax.Array], jax.Array],
                     expert_params: Any, *,
                     axis_name: str = "ep",
-                    capacity_factor: float = 1.25
+                    capacity_factor: float = 1.25,
+                    buffer_constraint: Callable[[jax.Array], jax.Array]
+                    = lambda x: x,
                     ) -> tuple[jax.Array, jax.Array]:
     """MoE layer inside a mapped context.
 
     tokens: local [T, D]; router_kernel: [D, E_total] replicated;
     expert_params: this device's experts, leaves [E_local, ...].
     Returns (output [T, D], aux_loss scalar).
+
+    ``buffer_constraint`` pins the expert buffers' sharding on the mesh
+    axes that stay automatic inside the caller's ``shard_map`` (the token
+    dim is reduced away building them, so they should be replicated over
+    dp/fsdp) — without it GSPMD's propagator smears batch shardings onto
+    the expert dim of the saved-for-backward buffers and pays an
+    involuntary full rematerialization each layer.
     """
     n = lax.axis_size(axis_name)
     T, D = tokens.shape
@@ -75,7 +84,8 @@ def moe_layer_local(tokens: jax.Array,
     dispatch, combine, aux = switch_route(logits, capacity)
 
     # Gather tokens into expert buffers: [E, C, D].
-    expert_inputs = jnp.einsum("tec,td->ecd", dispatch, tokens)
+    expert_inputs = buffer_constraint(
+        jnp.einsum("tec,td->ecd", dispatch, tokens))
     # Exchange: send each expert's buffer to its owner device.
     # [E, C, D] -> [n, E_local, C, D] -> a2a -> [n, E_local, C, D] where the
     # leading dim now indexes source rank.
@@ -83,18 +93,16 @@ def moe_layer_local(tokens: jax.Array,
     received = lax.all_to_all(shaped, axis_name, split_axis=0, concat_axis=0,
                               tiled=False)
     # received: [n, E_local, C, D] — tokens from every rank for my experts.
-    merged = received.reshape(n * E_local * capacity, D)
-    del merged
-    per_expert = received.transpose(1, 0, 2, 3).reshape(
-        E_local, n * capacity, D)
-    expert_out = jax.vmap(expert_fn)(
-        expert_params, per_expert)                            # [E_local, n*C, D]
+    per_expert = buffer_constraint(received.transpose(1, 0, 2, 3).reshape(
+        E_local, n * capacity, D))
+    expert_out = buffer_constraint(jax.vmap(expert_fn)(
+        expert_params, per_expert))                           # [E_local, n*C, D]
     # Route back: inverse exchange.
     back = expert_out.reshape(E_local, n, capacity, D).transpose(1, 0, 2, 3)
     returned = lax.all_to_all(back, axis_name, split_axis=0, concat_axis=0,
                               tiled=False)
     # returned: [n(expert-owner), E_local, C, D] == my tokens' results.
-    results = returned.reshape(E_total, capacity, D)
+    results = buffer_constraint(returned.reshape(E_total, capacity, D))
     out = jnp.einsum("tec,ecd->td", combine, results)
     return out.astype(tokens.dtype), aux
 

@@ -25,6 +25,12 @@ DEFAULT_RULES: dict[str, Union[None, str, tuple[str, ...]]] = {
     "head_dim": None,
     "qkv": None,
     "vocab": "tp",             # output projection vocab-parallel
+    # Embedding-table rows: sharding the table on its vocab (indexed) dim
+    # keeps the token gather partitionable — GSPMD lowers a gather from a
+    # row-sharded table to per-shard lookups + psum, whereas a table
+    # sharded on the embed (feature) dim forces an involuntary full
+    # rematerialization when the output wants batch sharding.
+    "vocab_rows": ("tp", "fsdp"),
     "experts": "ep",           # MoE experts over ep
     "expert_mlp": "tp",
     "stage": "pp",             # pipeline stage dimension (stacked params)

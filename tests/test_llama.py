@@ -4,6 +4,8 @@ Covers the mesh layouts the multi-chip dry run exercises: dp×sp×tp,
 dp×ep×tp (MoE), and dp×pp×tp (layer stack over pp).
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -13,6 +15,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu.models import llama
 from horovod_tpu.parallel import MeshConfig, build_mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _batch(cfg, B=4, S=16, seed=0):
@@ -127,6 +131,28 @@ def test_pp_pipeline_no_per_layer_param_gather():
             for shape in banned:
                 assert shape not in line.replace(" ", ""), (
                     f"per-layer param gather over pp: {line[:160]}")
+
+
+@pytest.mark.integration
+def test_multichip_dryrun_no_involuntary_remat():
+    """The full dp/tp/pp, sp/tp/dp and ep/fsdp/dp dryrun compiles must
+    emit zero SPMD 'Involuntary full rematerialization' warnings — each
+    one means XLA is replicating a tensor (HBM + ICI cost) because our
+    sharding annotations left a gap (round-2 verdict finding; fixed by
+    pinning scanned layer slices, gradient accumulators, and vocab-row
+    embedding sharding)."""
+    import subprocess
+    import sys as _sys
+    res = subprocess.run(
+        [_sys.executable, "-c",
+         "import sys; sys.path.insert(0, %r); "
+         "import __graft_entry__ as g; g.dryrun_multichip(8)" % REPO],
+        capture_output=True, text=True, timeout=900, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    bad = [ln for ln in res.stderr.splitlines()
+           if "Involuntary full rematerialization" in ln]
+    assert not bad, "involuntary resharding in flagship:\n" + "\n".join(
+        ln[:200] for ln in bad)
 
 
 def test_pp_rejects_sp_and_moe():
