@@ -20,7 +20,12 @@ Snapshots are host-side (device_get) so they survive mesh teardown —
 same as the reference's host-RAM ``TorchState`` copies.
 """
 
-from .state import State, ObjectState, JaxState  # noqa: F401
+from .state import (  # noqa: F401
+    FileBackedState,
+    JaxState,
+    ObjectState,
+    State,
+)
 from .sampler import ElasticSampler  # noqa: F401
 from .runner import (  # noqa: F401
     HostsUpdatedInterrupt,

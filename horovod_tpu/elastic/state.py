@@ -7,6 +7,8 @@
 from __future__ import annotations
 
 import copy
+import json
+import os
 from typing import Any, Callable, Optional
 
 import jax
@@ -79,6 +81,57 @@ class ObjectState(State):
         for k, v in synced.items():
             setattr(self, k, v)
         self.save()
+
+
+class FileBackedState(ObjectState):
+    """ObjectState whose commits also persist to disk.
+
+    The TPU elastic design restarts the whole job on membership change
+    (:mod:`horovod_tpu.runner.elastic`: blacklist + relaunch), so in-memory
+    snapshots alone cannot carry training state across incarnations — the
+    reference's in-process restore (†3.5) assumes the process survives.
+    Rank 0 writes a JSON snapshot atomically at every ``save()``; every
+    rank loads it at construction, so a relaunched job resumes from the
+    last commit of the previous incarnation.  When collectives are
+    already initialized, construction ends with a ``sync()`` broadcasting
+    rank 0's loaded values — so multi-host jobs stay consistent even when
+    ``path`` is host-local storage (only rank 0's copy is authoritative).
+    Jobs that construct the state before ``hvd.init()`` must either call
+    ``sync()`` themselves afterwards or put ``path`` on a filesystem all
+    hosts share.  Values must be JSON-serializable (scalars/lists/dicts);
+    large pytrees belong in :class:`JaxState` + orbax checkpoints instead.
+    """
+
+    def __init__(self, path: str, **kwargs: Any) -> None:
+        stored: dict[str, Any] = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                stored = json.load(f)
+        self._path = path          # before super().__init__ calls save()
+        self._resumed = bool(stored)
+        super().__init__(**{**kwargs, **stored})
+        import horovod_tpu as hvd
+        if hvd.is_initialized() and hvd.size() > 1:
+            self.sync()
+            # All ranks must agree whether this is a resume (rank 0's
+            # file is the authoritative one) or control flow diverges.
+            self._resumed = bool(
+                hvd.broadcast_object(self._resumed, root_rank=0))
+
+    @property
+    def resumed(self) -> bool:
+        """True when construction loaded a previous incarnation's commit."""
+        return self._resumed
+
+    def save(self) -> None:
+        super().save()
+        import horovod_tpu as hvd
+        if hvd.is_initialized() and hvd.rank() != 0:
+            return                 # † rank-0-only checkpoint convention
+        tmp = f"{self._path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self._public(), f)
+        os.replace(tmp, self._path)
 
 
 class JaxState(State):

@@ -35,12 +35,15 @@ class HostDiscovery:
 
 
 class ScriptDiscovery(HostDiscovery):
-    """† ``HostDiscoveryScript``: an executable printing ``host:slots``
-    lines (the ``--host-discovery-script`` contract)."""
+    """† ``HostDiscoveryScript``: an executable printing ``host[:slots]``
+    lines (the ``--host-discovery-script`` contract).  ``default_slots``
+    applies to bare hostnames († the ``--slots`` flag)."""
 
-    def __init__(self, script: str, timeout: float = 30.0) -> None:
+    def __init__(self, script: str, timeout: float = 30.0,
+                 default_slots: int = 1) -> None:
         self._script = script
         self._timeout = timeout
+        self._default_slots = default_slots
 
     def find_available_hosts(self) -> List[HostSlots]:
         res = subprocess.run([self._script], capture_output=True, text=True,
@@ -48,9 +51,14 @@ class ScriptDiscovery(HostDiscovery):
         if res.returncode != 0:
             raise RuntimeError(
                 f"discovery script failed ({res.returncode}): {res.stderr}")
-        spec = ",".join(line.strip() for line in res.stdout.splitlines()
-                        if line.strip())
-        return parse_hosts(spec) if spec else []
+        lines = [line.strip() for line in res.stdout.splitlines()
+                 if line.strip()]
+        if not lines:
+            return []
+        spec = ",".join(
+            line if ":" in line else f"{line}:{self._default_slots}"
+            for line in lines)
+        return parse_hosts(spec)
 
 
 class FixedDiscovery(HostDiscovery):
@@ -142,12 +150,15 @@ class ElasticDriver:
                 max_restarts: int = 10,
                 extra_env: Optional[dict] = None,
                 launcher: Optional[Callable] = None,
-                on_epoch_change: Optional[Callable] = None) -> int:
+                on_epoch_change: Optional[Callable] = None,
+                slot_timeout_s: float = 600.0,
+                launch_kwargs: Optional[dict] = None) -> int:
         """Supervise the elastic job: (re)launch on the current assignment
         until it exits 0 or restarts are exhausted.
 
         ``launcher`` defaults to :func:`horovod_tpu.runner.launch.launch_workers`
-        (injectable for tests).
+        (injectable for tests); ``launch_kwargs`` forwards launcher knobs
+        (ssh_port, verbose, connectivity_check, ...) to it.
         """
         if launcher is None:
             from .launch import launch_workers
@@ -159,7 +170,8 @@ class ElasticDriver:
                 failure: dict = {}
                 code = launch_workers(cmd, np_total=np_total,
                                       hosts_spec=spec, extra_env=env,
-                                      failure_info=failure)
+                                      failure_info=failure,
+                                      **(launch_kwargs or {}))
                 if code != 0 and failure.get("host") and len(hosts) > 1:
                     # † registration.py: exclude the crashed worker's host
                     # from the next assignment.  Sole-host jobs keep their
@@ -170,7 +182,7 @@ class ElasticDriver:
 
         restarts = 0
         while True:
-            hosts = self.wait_for_available_slots()
+            hosts = self.wait_for_available_slots(timeout_s=slot_timeout_s)
             epoch = self.membership_epoch
             log.info("elastic: launching on %s (epoch %d)", hosts, epoch)
             env = dict(extra_env or {})

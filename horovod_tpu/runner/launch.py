@@ -57,6 +57,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-H", "--hosts", default=None,
                    help="host1:slots,host2:slots (default: localhost:np)")
     p.add_argument("--ssh-port", type=int, default=22)
+    # Elastic mode († horovodrun --min-np/--max-np/--host-discovery-script):
+    # hosts come from a user script polled by the ElasticDriver, which
+    # supervises blacklist/relaunch instead of a single static launch.
+    p.add_argument("--min-np", type=int, default=None,
+                   help="minimum processes an elastic job may shrink to "
+                        "(default: -np)")
+    p.add_argument("--max-np", type=int, default=None,
+                   help="maximum processes an elastic job may grow to "
+                        "(default: -np)")
+    p.add_argument("--host-discovery-script", default=None,
+                   help="executable printing one 'host[:slots]' line per "
+                        "available host; enables elastic mode")
+    p.add_argument("--slots", type=int, default=None,
+                   help="default slots per discovered host when the "
+                        "discovery script prints bare hostnames")
+    p.add_argument("--elastic-timeout", type=float, default=None,
+                   help="seconds to wait for min-np slots before giving up "
+                        "(default 600)")
     p.add_argument("--start-timeout", type=float, default=120.0,
                    help="seconds to wait for all workers to register")
     p.add_argument("--config-file", default=None,
@@ -410,10 +428,50 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print("hvdrun: -np/--num-proc (>= 1) is required", file=sys.stderr)
         return 2
     extra_env = _knob_env(args)
+    if args.host_discovery_script:
+        return run_elastic(command, args, extra_env)
+    if (args.min_np is not None or args.max_np is not None
+            or args.slots is not None or args.elastic_timeout is not None):
+        print("hvdrun: --min-np/--max-np/--slots/--elastic-timeout require "
+              "--host-discovery-script (elastic mode)", file=sys.stderr)
+        return 2
     return launch_workers(command, np_total=args.num_proc,
                           hosts_spec=args.hosts, extra_env=extra_env,
                           ssh_port=args.ssh_port, verbose=args.verbose,
                           connectivity_check=not args.no_connectivity_check)
+
+
+def run_elastic(command: Sequence[str], args, extra_env: dict) -> int:
+    """Elastic CLI path († ``horovodrun -np 2 --min-np 1
+    --host-discovery-script ./d.sh python train.py``): hand supervision to
+    the ElasticDriver, which polls discovery, blacklists crashed hosts,
+    and relaunches on the surviving assignment; workers resume from their
+    last ``state.commit()``."""
+    from .elastic import ElasticDriver, ScriptDiscovery
+
+    if args.hosts:
+        print("hvdrun: -H/--hosts conflicts with --host-discovery-script "
+              "(elastic hosts come from the discovery script)",
+              file=sys.stderr)
+        return 2
+    min_np = args.min_np if args.min_np is not None else args.num_proc
+    max_np = args.max_np if args.max_np is not None else args.num_proc
+    if not (1 <= min_np <= args.num_proc <= max_np):
+        print(f"hvdrun: need 1 <= min-np ({min_np}) <= np "
+              f"({args.num_proc}) <= max-np ({max_np})", file=sys.stderr)
+        return 2
+    discovery = ScriptDiscovery(args.host_discovery_script,
+                                default_slots=args.slots or 1)
+    driver = ElasticDriver(discovery, min_np=min_np, max_np=max_np)
+    return driver.run_job(
+        command, extra_env=extra_env,
+        slot_timeout_s=(args.elastic_timeout
+                        if args.elastic_timeout is not None else 600.0),
+        launch_kwargs={
+            "ssh_port": args.ssh_port,
+            "verbose": args.verbose,
+            "connectivity_check": not args.no_connectivity_check,
+        })
 
 
 if __name__ == "__main__":

@@ -1,0 +1,65 @@
+"""Worker for the elastic-CLI end-to-end test.
+
+Trains a toy "model" (a scalar advanced by negotiated allreduce) for
+TOTAL_STEPS, committing a :class:`FileBackedState` each step.  When run at
+size 2, rank 1 hard-crashes at step 3 *before* that step's collective —
+the launcher sees the nonzero exit, the ElasticDriver blacklists the
+crashed worker's host and relaunches at np=1, and the surviving worker
+resumes from the last committed step.  † ``test/integration/elastic``
+kill-a-worker scripts; the TPU adaptation restarts the job rather than
+patching the ring (see :mod:`horovod_tpu.runner.elastic`).
+
+Per-step arithmetic (so the test can assert exact continuity):
+``w <- allreduce_sum(w + 1)`` = ``size * (w + 1)`` — any lost or repeated
+step changes the final value.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=1"
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.elastic import FileBackedState  # noqa: E402
+
+TOTAL_STEPS = 6
+KILL_STEP = 3
+
+
+def log_line(path: str, text: str) -> None:
+    with open(path, "a") as f:
+        f.write(text + "\n")
+
+
+def main() -> int:
+    state_path = os.environ["HVDTPU_TEST_STATE"]
+    log_path = os.environ["HVDTPU_TEST_LOG"]
+    hvd.init()
+    me, n = hvd.rank(), hvd.size()
+    state = FileBackedState(state_path, step=0, w=0.0)
+    log_line(log_path,
+             f"START rank={me} size={n} resume_step={state.step} "
+             f"w={state.w}")
+    for step in range(state.step, TOTAL_STEPS):
+        if n == 2 and me == 1 and step == KILL_STEP:
+            log_line(log_path, f"CRASH rank={me} step={step}")
+            os._exit(7)
+        x = hvd.from_local(np.full((1, 1), state.w + 1.0, np.float32))
+        out = hvd.to_numpy(hvd.synchronize(
+            hvd.allreduce_async(x, hvd.Sum, name=f"w.{step}")))
+        state.w = float(out[0])
+        state.step = step + 1
+        state.commit()
+        log_line(log_path, f"STEP rank={me} size={n} step={step} w={state.w}")
+    hvd.shutdown()
+    log_line(log_path, f"DONE rank={me} size={n} step={state.step} "
+                       f"w={state.w}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -150,6 +150,54 @@ def test_hvdrun_torch_distributed_optimizer():
 
 
 @pytest.mark.integration
+def test_hvdrun_elastic_kill_blacklist_relaunch(tmp_path):
+    """† test/integration/elastic: full elastic circle through the CLI.
+
+    np=2 via a discovery script naming two 'hosts' (localhost and
+    127.0.0.1 — distinct for blacklisting, both exec'd locally); rank 1
+    hard-crashes at step 3; the ElasticDriver must blacklist its host,
+    relaunch at np=1, and the survivor must resume from the last
+    state.commit() with exact value continuity (w follows
+    ``w <- size*(w+1)``: 2,6,14 at np=2, then 15,16,17 at np=1)."""
+    discover = tmp_path / "discover.sh"
+    discover.write_text("#!/bin/sh\necho localhost:1\necho 127.0.0.1:1\n")
+    discover.chmod(0o755)
+    state = tmp_path / "state.json"
+    log = tmp_path / "train.log"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["HVDTPU_TEST_STATE"] = str(state)
+    env["HVDTPU_TEST_LOG"] = str(log)
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         "--min-np", "1", "--max-np", "2",
+         "--host-discovery-script", str(discover), "--",
+         sys.executable, os.path.join(REPO, "tests", "mp_elastic_worker.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    lines = log.read_text().splitlines()
+    assert "START rank=0 size=2 resume_step=0 w=0.0" in lines
+    assert "CRASH rank=1 step=3" in lines
+    # Relaunched at np=1 from the last commit (step 3, w=14), not from 0.
+    assert "START rank=0 size=1 resume_step=3 w=14.0" in lines
+    assert "DONE rank=0 size=1 step=6 w=17.0" in lines
+    import json as _json
+    final = _json.loads(state.read_text())
+    assert final == {"step": 6, "w": 17.0}
+
+
+@pytest.mark.integration
+def test_hvdrun_elastic_flags_require_discovery():
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         "--min-np", "1", "--", "python", "x.py"],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert res.returncode == 2
+    assert "host-discovery-script" in res.stderr
+
+
+@pytest.mark.integration
 def test_hvdrun_check_build():
     """† horovodrun --check-build prints capabilities without launching."""
     res = subprocess.run(
