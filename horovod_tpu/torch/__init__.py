@@ -203,20 +203,47 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
 class _DistributedOptimizer(torch.optim.Optimizer):
     """† ``horovod/torch/optimizer.py _DistributedOptimizer``: grad hooks
     enqueue async allreduces during backward; ``step()`` synchronizes and
-    applies averaged gradients."""
+    applies averaged gradients.
+
+    Transfer batching (beyond the reference's per-tensor zero-copy
+    adapters, which a host-bridge cannot have): gradients are staged into
+    per-dtype host buckets as hooks fire; a bucket flushes — ONE
+    host→device transfer and ONE fused collective — when it reaches
+    ``bucket_cap_bytes`` (default: the engine's fusion threshold), and the
+    remainder flushes at ``synchronize()``.  Write-back is one
+    device→host fetch per bucket.  So host traffic per step is
+    O(total_bytes / bucket_cap), not O(n_params), while flushed buckets
+    still overlap the rest of backward.  Bucket composition follows hook
+    firing order, which torch keeps deterministic for a fixed graph — the
+    same property the reference's response cache relies on for its
+    steady-state bit-vector fast path.
+    """
 
     def __init__(self, optimizer: torch.optim.Optimizer,
                  named_parameters=None,
                  op: ReduceOp = Average,
                  compression=Compression.none,
-                 backward_passes_per_step: int = 1) -> None:
+                 backward_passes_per_step: int = 1,
+                 bucket_cap_bytes: Optional[int] = None) -> None:
         self._inner = optimizer
         self.op = op
         self._compression = compression
         self._bpps = backward_passes_per_step
+        self._bucket_cap = bucket_cap_bytes
+        if self._bucket_cap is None and _hvd.is_initialized():
+            # Latch now, before any autotune proposal can move the live
+            # threshold (ranks construct the optimizer at the same point,
+            # so the latched value agrees everywhere).
+            self._bucket_cap = \
+                _hvd.global_state().config.fusion_threshold
         self._pass_counts: dict = {}
-        self._handles: dict = {}
-        self._ctxs: dict = {}
+        # dtype-key -> list of (param, host_grad_array) awaiting flush
+        self._staged: dict = {}
+        self._staged_bytes: dict = {}
+        # list of in-flight bucket records
+        self._inflight: list = []
+        self._pending_params: set = set()
+        self._bucket_seq = 0
         if named_parameters is not None:
             names = {id(p): n for n, p in named_parameters}
         else:
@@ -246,6 +273,16 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def _name_of(self, p: torch.Tensor) -> str:
         return self._names.get(id(p), f"param.{id(p)}")
 
+    def _cap_bytes(self) -> int:
+        # Latched once: bucket boundaries decide bucket names, which must
+        # match on every rank.  Reading the live config each hook would
+        # diverge under autotune (each rank tunes fusion_threshold from
+        # local timings), deadlocking negotiation on mismatched buckets.
+        if self._bucket_cap is None:
+            self._bucket_cap = \
+                _hvd.global_state().config.fusion_threshold
+        return self._bucket_cap
+
     def _make_hook(self, p: torch.Tensor):
         def hook(param: torch.Tensor) -> None:
             # Local gradient aggregation († backward_passes_per_step): torch
@@ -256,37 +293,85 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             if count < self._bpps:
                 return
             self._pass_counts[p] = 0
-            if p in self._handles:
+            if p in self._pending_params:
                 raise RuntimeError(
                     f"gradient for {self._name_of(p)} reduced twice before "
                     "step() — call step() once per backward "
                     "(† duplicate in-flight name check)")
-            grad = param.grad
-            arr = grad.detach().cpu().numpy()
+            self._pending_params.add(p)
+            arr = param.grad.detach().cpu().numpy()
             if self._bpps > 1:
                 arr = arr / self._bpps
-            import jax.numpy as jnp
-            from horovod_tpu.ops.collectives import replicate_local
-            wire, ctx = self._compression.compress(jnp.asarray(arr))
-            handle = _hvd.allreduce_async(
-                replicate_local(np.asarray(wire)),
-                self.op, name=f"grad.{self._name_of(p)}")
-            self._handles[p] = handle
-            self._ctxs[p] = (ctx, grad.dtype)
+            key = str(arr.dtype)
+            self._staged.setdefault(key, []).append((p, arr))
+            nbytes = self._staged_bytes.get(key, 0) + arr.nbytes
+            self._staged_bytes[key] = nbytes
+            # Adasum's projection is per-tensor math, not elementwise —
+            # concatenating tensors would change the result, so each grad
+            # flushes as its own single-entry bucket.
+            if self.op is Adasum or nbytes >= self._cap_bytes():
+                self._flush_bucket(key)
         return hook
 
+    def _flush_bucket(self, key: str) -> None:
+        """Stage one dtype bucket to the device and enqueue ONE fused
+        allreduce for it."""
+        entries = self._staged.pop(key, [])
+        self._staged_bytes.pop(key, None)
+        if not entries:
+            return
+        import hashlib
+
+        import jax.numpy as jnp
+        from horovod_tpu.ops.collectives import replicate_local
+        flat = (entries[0][1].ravel() if len(entries) == 1 else
+                np.concatenate([a.ravel() for _, a in entries]))
+        wire, ctx = self._compression.compress(jnp.asarray(flat))
+        seq = self._bucket_seq
+        self._bucket_seq += 1
+        # Content fingerprint (member names + sizes): ranks whose hook
+        # firing sets diverge (data-dependent unused params) produce
+        # different names, so negotiation stalls loudly instead of fusing
+        # unrelated gradients into a silently corrupt bucket.
+        fp = hashlib.sha1("|".join(
+            f"{self._name_of(p)}:{a.size}" for p, a in entries)
+            .encode()).hexdigest()[:10]
+        handle = _hvd.allreduce_async(
+            replicate_local(np.asarray(wire)), self.op,
+            name=f"gradbucket.{key}.{seq}.{fp}")
+        self._inflight.append((handle, entries, ctx))
+
     def synchronize(self) -> None:
-        """† ``synchronize()``: block on all outstanding grad reductions and
-        write results back into ``p.grad``."""
-        for p, handle in self._handles.items():
-            result = _hvd.synchronize(handle)
-            ctx, dtype = self._ctxs[p]
-            result = self._compression.decompress(result, ctx)
-            with torch.no_grad():
-                p.grad.copy_(torch.from_numpy(
-                    np.array(_hvd.to_numpy(result))).to(dtype=dtype))
-        self._handles.clear()
-        self._ctxs.clear()
+        """† ``synchronize()``: flush staged buckets, block on all
+        outstanding reductions, and write results back into ``p.grad``
+        (one device→host fetch per bucket).
+
+        Staging state is cleared even when a collective errors
+        (HorovodInternalError) so the elastic restore/retry path can run
+        a fresh backward without a spurious 'reduced twice' error.
+        """
+        try:
+            for key in list(self._staged):
+                self._flush_bucket(key)
+            for handle, entries, ctx in self._inflight:
+                result = _hvd.synchronize(handle)
+                result = self._compression.decompress(result, ctx)
+                host = np.asarray(_hvd.to_numpy(result))
+                offset = 0
+                for p, arr in entries:
+                    piece = host[offset:offset + arr.size].reshape(arr.shape)
+                    offset += arr.size
+                    with torch.no_grad():
+                        p.grad.copy_(torch.from_numpy(np.array(piece))
+                                     .to(dtype=p.grad.dtype))
+        finally:
+            self._inflight.clear()
+            self._staged.clear()
+            self._staged_bytes.clear()
+            self._pending_params.clear()
+            # Names restart each step so the dispatch/response caches see
+            # the identical signature sequence every iteration.
+            self._bucket_seq = 0
 
     def step(self, closure=None):
         if self._bpps > 1 and any(self._pass_counts.values()):
@@ -312,13 +397,15 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          named_parameters=None,
                          op: ReduceOp = Average,
                          compression=Compression.none,
-                         backward_passes_per_step: int = 1
+                         backward_passes_per_step: int = 1,
+                         bucket_cap_bytes: Optional[int] = None
                          ) -> _DistributedOptimizer:
     """† ``hvd.DistributedOptimizer`` for torch."""
     return _DistributedOptimizer(
         optimizer, named_parameters=named_parameters, op=op,
         compression=compression,
-        backward_passes_per_step=backward_passes_per_step)
+        backward_passes_per_step=backward_passes_per_step,
+        bucket_cap_bytes=bucket_cap_bytes)
 
 
 def __getattr__(name: str):
