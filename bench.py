@@ -208,15 +208,21 @@ def main() -> None:
 
     probe_timeout = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "90"))
     bench_timeout = float(os.environ.get("BENCH_TIMEOUT", "900"))
+    # Poll the probe on a backoff schedule instead of giving up after two
+    # tries: the tunnel flaps, and a bench window is worth waiting out
+    # (BENCH_r01/r02 both fell to CPU on transient tunnel downtime).
+    probe_attempts = int(os.environ.get("BENCH_TPU_PROBE_ATTEMPTS", "5"))
+    backoffs = [5, 15, 30, 60]
 
     diags = []
     ok = False
-    for attempt in range(2):
+    for attempt in range(probe_attempts):
         ok, diag = probe_tpu(probe_timeout)
         if ok:
             break
         diags.append(f"probe#{attempt + 1}: {diag}")
-        time.sleep(5)
+        if attempt + 1 < probe_attempts:
+            time.sleep(backoffs[min(attempt, len(backoffs) - 1)])
 
     if ok:
         result, diag = _run_worker("tpu", bench_timeout)

@@ -52,8 +52,28 @@ def _so_path() -> str:
     """
     if os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
         src_so = os.path.join(_NATIVE_DIR, "libhvdtpu_core.so")
-        subprocess.run(["make", "-C", _NATIVE_DIR],
-                       check=True, capture_output=True)
+        # Serialize the (possible) rebuild: hvdrun starts N workers that
+        # import concurrently, and N unlocked makes would write the .so
+        # while siblings dlopen it mid-write.  A failed rebuild (no
+        # toolchain, read-only checkout) falls back to the committed .so
+        # when one exists — only a missing binary is fatal.
+        try:
+            import fcntl
+            with open(os.path.join(_NATIVE_DIR, ".build.lock"), "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                subprocess.run(["make", "-C", _NATIVE_DIR],
+                               check=True, capture_output=True, text=True)
+        except (OSError, subprocess.CalledProcessError) as err:
+            if not os.path.exists(src_so):
+                detail = getattr(err, "stderr", "") or str(err)
+                raise OSError(
+                    f"native core build failed and no prebuilt "
+                    f"libhvdtpu_core.so exists: {detail}") from err
+            import warnings
+            warnings.warn(
+                f"could not rebuild native core ({err.__class__.__name__}); "
+                "using the existing libhvdtpu_core.so, which may be stale "
+                "relative to hvdtpu_core.cc", RuntimeWarning)
         return src_so
     wheel_so = os.path.join(_PKG_DIR, "libhvdtpu_core.so")
     if os.path.exists(wheel_so):

@@ -107,15 +107,23 @@ def _joinable_entry(e: TensorTableEntry) -> bool:
 
 
 def _parse_joinable_meta(meta: str) -> Optional[dict]:
-    """Parse an echoed descriptor; None unless it describes a joinable
-    (allreduce) entry.  The joined-rank half of :func:`_joinable_entry`."""
+    """Parse an echoed descriptor; None unless it fully describes a
+    joinable (allreduce) entry — verb, shape, dtype, and reduce op must
+    all be present and well-formed, so :meth:`CollectiveEngine._zero_entry`
+    is total on accepted metas (a half-valid descriptor from a
+    version-skewed peer must be skipped, not crash the cycle thread).
+    The joined-rank half of :func:`_joinable_entry`."""
     if not meta:
         return None
     try:
         m = json.loads(meta)
-    except ValueError:
-        return None
-    if m.get("v") != "allreduce":
+        if m.get("v") != "allreduce":
+            return None
+        m["s"] = [int(d) for d in m["s"]]
+        C.ReduceOp(m["o"])
+        if not isinstance(m["d"], str):
+            return None
+    except (ValueError, TypeError, KeyError):
         return None
     return m
 
@@ -391,6 +399,7 @@ class CollectiveEngine:
             return
         by_name = {e.name: e for e in entries}
         ready: list[TensorTableEntry] = []
+        errored: set[int] = set()
         for name in outcome.ready:
             e = by_name.get(name)
             if e is not None:
@@ -401,6 +410,7 @@ class CollectiveEngine:
                     # the result), so every rank errors this entry instead
                     # of dispatching.  The joined rank skips it by the same
                     # rule (below), keeping the mesh consistent — no hang.
+                    errored.add(id(e))
                     with self._lock:
                         self._names_pending.discard(e.name)
                     self._tl_close(e)
@@ -425,11 +435,21 @@ class CollectiveEngine:
                         "join: skipping non-joinable ready tensor %r "
                         "(it errors on the ranks that submitted it)", name)
                     continue
-                e = self._zero_entry(name, meta)
+                try:
+                    e = self._zero_entry(name, meta)
+                except Exception as err:  # defensive: never kill the cycle
+                    log.error(
+                        "join: failed to build zero participation for %r "
+                        "(%s); skipping — peers may stall (stall inspector "
+                        "will report)", name, err)
+                    continue
                 handles[id(e)] = Handle(e.name)  # result dropped
                 ready.append(e)
-        ready_ids = {id(e) for e in ready}
-        deferred = [(e, h) for e, h in batch if id(e) not in ready_ids]
+        # Errored entries are consumed too — re-queueing them would
+        # renegotiate a dead tensor every cycle (livelock) and re-complete
+        # an already-errored handle.
+        consumed_ids = {id(e) for e in ready} | errored
+        deferred = [(e, h) for e, h in batch if id(e) not in consumed_ids]
         if deferred:
             with self._lock:
                 self._queue = deferred + self._queue
@@ -515,9 +535,11 @@ class CollectiveEngine:
         † JoinOp semantics: the joined rank supplies zeros of the same
         shape/dtype; AVERAGE divides by the full world size including
         joined ranks (reference behavior).  ``m`` is a descriptor already
-        validated by :func:`_parse_joinable_meta`, so construction cannot
-        fail on verb/shape grounds; dtype resolution goes through jnp so
-        extended types (bfloat16, fp8) work.
+        validated by :func:`_parse_joinable_meta` (verb, shape, dtype and
+        op all checked); dtype resolution goes through jnp so extended
+        types (bfloat16, fp8) work.  The caller still guards the call —
+        an unresolvable dtype string must skip the tensor, not crash the
+        cycle thread.
         """
         import jax.numpy as jnp
         import numpy as np

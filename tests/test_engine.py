@@ -182,6 +182,18 @@ def test_join_covered_non_allreduce_errors():
         h = hvd.allgather_async(x, name="t.cov.ag")
         with pytest.raises(hvd.HorovodInternalError, match="allreduce"):
             hvd.synchronize(h)
+        # The errored entry must be consumed, not re-queued: a deferred
+        # dead tensor would renegotiate every cycle forever (livelock —
+        # code-review finding).
+        deadline = time.monotonic() + 2
+        while time.monotonic() < deadline:
+            with eng._lock:
+                if not eng._queue and "t.cov.ag" not in eng._names_pending:
+                    break
+            time.sleep(0.01)
+        with eng._lock:
+            assert not eng._queue
+            assert "t.cov.ag" not in eng._names_pending
         hb = hvd.broadcast_async(x, 0, name="t.cov.bc")
         with pytest.raises(hvd.HorovodInternalError, match="allreduce"):
             hvd.synchronize(hb)
