@@ -1,0 +1,92 @@
+"""Cloud TPU-VM slice discovery: synthesize the job host list from the
+GCE metadata server instead of hand-written ``-H`` specs.
+
+† ``horovod/runner/driver/driver_service.py`` role (auto host inventory);
+on TPU pods the inventory source is the instance metadata every TPU VM
+worker serves: ``worker-network-endpoints`` lists each worker's internal
+IP — the same source ``jax.distributed`` uses for its own cluster
+bootstrap.  One process per host VM is the deployment model (each
+process drives all its local chips), so slots default to 1.
+
+The metadata root is overridable via ``HVDTPU_METADATA_ROOT`` so tests
+(and non-GCE emulation rigs) can point it at a mock server.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+from .hosts import HostSlots
+
+_DEFAULT_ROOT = "http://metadata.google.internal/computeMetadata/v1"
+_IPV4 = re.compile(r"^\d{1,3}(\.\d{1,3}){3}$")
+
+
+class MetadataUnavailable(RuntimeError):
+    """The metadata server is absent/unreachable (not on a TPU VM)."""
+
+
+def _metadata_root() -> str:
+    return os.environ.get("HVDTPU_METADATA_ROOT", _DEFAULT_ROOT)
+
+
+def get_attribute(name: str, timeout: float = 5.0) -> str:
+    """Fetch ``instance/attributes/<name>`` with the required
+    ``Metadata-Flavor`` header."""
+    url = f"{_metadata_root()}/instance/attributes/{name}"
+    req = urllib.request.Request(url, headers={"Metadata-Flavor": "Google"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read().decode()
+    except (urllib.error.URLError, OSError, TimeoutError) as err:
+        raise MetadataUnavailable(
+            f"cannot read TPU-VM metadata {name!r} from "
+            f"{_metadata_root()} ({err}); not on a TPU VM? "
+            "Pass -H host:slots explicitly.") from err
+
+
+def parse_worker_endpoints(raw: str) -> List[str]:
+    """Worker internal IPs from ``worker-network-endpoints``.
+
+    Entries are ','-separated, each a ':'-joined record whose fields vary
+    by provisioning era; the IPv4-looking field is the worker address
+    (matching how jax's cloud bootstrap reads it).
+    """
+    ips: List[str] = []
+    for entry in raw.replace(";", ",").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        ip = next((f for f in entry.split(":") if _IPV4.match(f)), None)
+        if ip:
+            ips.append(ip)
+    return ips
+
+
+def tpu_pod_hosts(default_slots: Optional[int] = None) -> List[HostSlots]:
+    """Host list for the current TPU pod slice.
+
+    Slots default to 1: the TPU-native deployment model is one process
+    per host VM driving all its local chips through ``jax.distributed``
+    (see :mod:`horovod_tpu.context`) — the reference's process-per-GPU
+    slot model maps to process-per-host here.  ``default_slots`` > 1 is
+    for users who partition chips themselves (``TPU_VISIBLE_DEVICES``
+    per local rank).
+    """
+    ips = parse_worker_endpoints(get_attribute("worker-network-endpoints"))
+    if not ips:
+        raise MetadataUnavailable(
+            "worker-network-endpoints metadata was empty")
+    return [HostSlots(ip, default_slots or 1) for ip in ips]
+
+
+def worker_number() -> Optional[int]:
+    """This worker's index in the slice (``agent-worker-number``)."""
+    try:
+        return int(get_attribute("agent-worker-number").strip())
+    except (MetadataUnavailable, ValueError):
+        return None

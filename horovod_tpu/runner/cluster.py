@@ -49,7 +49,8 @@ class DriverServices:
     """
 
     def __init__(self, num_proc: int, *, service_ip: Optional[str] = None,
-                 secret: Optional[str] = None) -> None:
+                 secret: Optional[str] = None,
+                 stall_shutdown_s: Optional[float] = None) -> None:
         from .._native import ControllerServer, KvServer
 
         if num_proc < 1:
@@ -59,9 +60,23 @@ class DriverServices:
             or _secrets.token_hex(16)
         self.service_ip = service_ip or local_ip()
         self.kv = KvServer(secret=self.secret)
+        # Round-barrier abort tracks the stall-shutdown opt-in: with
+        # shutdown enabled, a rank whose peers stop checking in must be
+        # released with an error rather than blocked in recv where its
+        # own stall inspector cannot run († error Response to all ranks).
+        # Callers whose stall knob does not live in this process's env
+        # (hvdrun --config-file puts it only in the WORKER env) must pass
+        # ``stall_shutdown_s`` explicitly.
+        if stall_shutdown_s is None:
+            from .. import config as config_mod
+            stall_shutdown_s = config_mod.from_env().stall_shutdown_time_s
+        round_abort_ms = 0
+        if stall_shutdown_s and stall_shutdown_s > 0:
+            round_abort_ms = int(stall_shutdown_s * 2 * 1000)
         try:
             self.controller = ControllerServer(size=num_proc,
-                                               secret=self.secret)
+                                               secret=self.secret,
+                                               round_abort_ms=round_abort_ms)
         except Exception:
             self.kv.stop()  # construction failed; __exit__ will never run
             raise

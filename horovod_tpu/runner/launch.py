@@ -56,6 +56,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(† horovodrun --check-build)")
     p.add_argument("-H", "--hosts", default=None,
                    help="host1:slots,host2:slots (default: localhost:np)")
+    p.add_argument("--tpu-pod", action="store_true", default=False,
+                   help="discover the host list from TPU-VM instance "
+                        "metadata (worker-network-endpoints) instead of "
+                        "-H; one process per host VM († driver_service "
+                        "auto-discovery)")
     p.add_argument("--ssh-port", type=int, default=22)
     # Elastic mode († horovodrun --min-np/--max-np/--host-discovery-script):
     # hosts come from a user script polled by the ElasticDriver, which
@@ -70,8 +75,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="executable printing one 'host[:slots]' line per "
                         "available host; enables elastic mode")
     p.add_argument("--slots", type=int, default=None,
-                   help="default slots per discovered host when the "
-                        "discovery script prints bare hostnames")
+                   help="default slots per discovered host (elastic "
+                        "discovery scripts printing bare hostnames; with "
+                        "--tpu-pod only for setups partitioning chips "
+                        "per-process themselves via TPU_VISIBLE_DEVICES)")
     p.add_argument("--elastic-timeout", type=float, default=None,
                    help="seconds to wait for min-np slots before giving up "
                         "(default 600)")
@@ -177,8 +184,19 @@ def launch_workers(command: Sequence[str], *, np_total: int,
     # setdefault) so an explicitly passed secret wins over a stale one.
     os.environ["HVDTPU_SECRET"] = job_secret
 
+    # The stall-shutdown knob decides the controller's round-abort
+    # timeout; it may arrive via --config-file (worker-env only), so
+    # consult the worker env block before the launcher's own env.
+    stall_env = ((extra_env or {}).get("HVDTPU_STALL_SHUTDOWN_TIME_SECONDS")
+                 or os.environ.get("HVDTPU_STALL_SHUTDOWN_TIME_SECONDS")
+                 or os.environ.get("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"))
+    try:
+        stall_shutdown_s = float(stall_env) if stall_env else None
+    except ValueError:
+        stall_shutdown_s = None  # config parsing rejects it worker-side
     services = DriverServices(np_total, service_ip=service_ip,
-                              secret=job_secret)
+                              secret=job_secret,
+                              stall_shutdown_s=stall_shutdown_s)
     if is_local_job:
         coord_port = _free_port()
         coord_host = "127.0.0.1"
@@ -424,6 +442,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if not command:
         print("hvdrun: no command given", file=sys.stderr)
         return 2
+    if args.tpu_pod:
+        if args.hosts:
+            print("hvdrun: --tpu-pod conflicts with -H/--hosts",
+                  file=sys.stderr)
+            return 2
+        from .cloud import MetadataUnavailable, tpu_pod_hosts
+        try:
+            pod = tpu_pod_hosts(default_slots=args.slots)
+        except MetadataUnavailable as e:
+            print(f"hvdrun: {e}", file=sys.stderr)
+            return 2
+        args.hosts = ",".join(f"{h.hostname}:{h.slots}" for h in pod)
+        args.slots = None   # consumed; keep the elastic-only guard honest
+        if args.num_proc is None:
+            args.num_proc = sum(h.slots for h in pod)
+        if args.verbose:
+            print(f"[launcher] tpu-pod discovery: {args.hosts}",
+                  file=sys.stderr)
     if args.num_proc is None or args.num_proc < 1:
         print("hvdrun: -np/--num-proc (>= 1) is required", file=sys.stderr)
         return 2

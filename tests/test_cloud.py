@@ -1,0 +1,110 @@
+"""TPU-VM metadata slice discovery († driver_service auto host inventory,
+re-sourced from the GCE metadata server) against a mocked endpoint."""
+
+import http.server
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from horovod_tpu.runner.cloud import (
+    MetadataUnavailable,
+    parse_worker_endpoints,
+    tpu_pod_hosts,
+    worker_number,
+)
+from horovod_tpu.runner.hosts import HostSlots
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TPU_ENV = (
+    "ACCELERATOR_TYPE: 'v5p-16'\n"
+    "CHIPS_PER_HOST_BOUNDS: '2,2,1'\n"
+    "HOST_BOUNDS: '2,1,1'\n"
+)
+
+
+class _Meta(http.server.BaseHTTPRequestHandler):
+    attrs = {
+        "worker-network-endpoints":
+            "uid-0:8470:10.130.0.2,uid-1:8470:10.130.0.3",
+        "tpu-env": TPU_ENV,
+        "agent-worker-number": "1",
+    }
+
+    def do_GET(self):  # noqa: N802 (stdlib naming)
+        if self.headers.get("Metadata-Flavor") != "Google":
+            self.send_error(403)
+            return
+        name = self.path.rsplit("/", 1)[-1]
+        if name not in self.attrs:
+            self.send_error(404)
+            return
+        body = self.attrs[name].encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture()
+def meta_server(monkeypatch):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Meta)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    monkeypatch.setenv("HVDTPU_METADATA_ROOT",
+                       f"http://127.0.0.1:{srv.server_address[1]}"
+                       "/computeMetadata/v1")
+    yield srv
+    srv.shutdown()
+
+
+def test_parse_worker_endpoints_formats():
+    assert parse_worker_endpoints(
+        "uid:8470:10.0.0.2,uid:8470:10.0.0.3") == ["10.0.0.2", "10.0.0.3"]
+    # semicolon-separated + different field orders also appear in the wild
+    assert parse_worker_endpoints(
+        "10.0.0.4:uid;10.0.0.5:uid") == ["10.0.0.4", "10.0.0.5"]
+    assert parse_worker_endpoints("") == []
+
+
+def test_tpu_pod_hosts_from_mock(meta_server):
+    # One process per host VM is the TPU-native model (each drives all
+    # its local chips); --slots overrides for self-partitioned setups.
+    hosts = tpu_pod_hosts()
+    assert hosts == [HostSlots("10.130.0.2", 1), HostSlots("10.130.0.3", 1)]
+    assert tpu_pod_hosts(default_slots=4)[0].slots == 4
+    assert worker_number() == 1
+
+
+def test_tpu_pod_hosts_unreachable(monkeypatch):
+    monkeypatch.setenv("HVDTPU_METADATA_ROOT", "http://127.0.0.1:1/none")
+    with pytest.raises(MetadataUnavailable, match="-H host:slots"):
+        tpu_pod_hosts()
+
+
+@pytest.mark.integration
+def test_hvdrun_tpu_pod_flag_without_metadata():
+    env = dict(os.environ)
+    env["HVDTPU_METADATA_ROOT"] = "http://127.0.0.1:1/none"
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "--tpu-pod", "--",
+         "python", "x.py"],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO)
+    assert res.returncode == 2
+    assert "metadata" in res.stderr.lower()
+
+
+@pytest.mark.integration
+def test_hvdrun_tpu_pod_conflicts_with_hosts():
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         "--tpu-pod", "-H", "a:1", "--", "python", "x.py"],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert res.returncode == 2
+    assert "conflicts" in res.stderr
