@@ -121,7 +121,8 @@ def load() -> ctypes.CDLL:
         # Controller
         lib.hvd_ctrl_server_start.restype = ctypes.c_void_p
         lib.hvd_ctrl_server_start.argtypes = [ctypes.c_int, ctypes.c_int,
-                                              ctypes.c_int, ctypes.c_char_p]
+                                              ctypes.c_int, ctypes.c_char_p,
+                                              ctypes.c_int]
         lib.hvd_ctrl_server_port.restype = ctypes.c_int
         lib.hvd_ctrl_server_port.argtypes = [ctypes.c_void_p]
         lib.hvd_ctrl_server_stop.argtypes = [ctypes.c_void_p]
@@ -221,14 +222,22 @@ class KvClient:
 
 
 class ControllerServer:
-    """Rank-0 coordinator service († ``controller.cc``)."""
+    """Rank-0 coordinator service († ``controller.cc``).
+
+    ``round_abort_ms`` > 0: a rank blocked in the per-round barrier that
+    long gets an abort reply (its engine errors pending work) instead of
+    waiting forever for a dead peer; 0 disables — long legitimate rounds
+    (first XLA compile) must survive unless stall shutdown is opted into.
+    """
 
     def __init__(self, size: int, port: int = 0,
                  stall_warn_ms: int = 60000,
-                 secret: Optional[str] = None) -> None:
+                 secret: Optional[str] = None,
+                 round_abort_ms: int = 0) -> None:
         self._lib = load()
         self._h = self._lib.hvd_ctrl_server_start(port, size, stall_warn_ms,
-                                                  job_secret(secret))
+                                                  job_secret(secret),
+                                                  round_abort_ms)
         if not self._h:
             raise OSError(f"failed to start controller on port {port}")
 
@@ -267,18 +276,27 @@ class ControllerClient:
                   timeout_ms: int = 60000) -> "NegotiationResult":
         """Submit pending tensors; block until the round completes.
 
-        ``names``: list of tensor names, or (name, meta) pairs — ``meta`` is
-        an opaque descriptor (travels once per tensor; the coordinator echoes
-        it on ready tensors so joined ranks can build zero participation).
+        ``names``: list of tensor names, (name, meta) pairs, or
+        (name, meta, members) triples — ``meta`` is an opaque descriptor
+        (travels once per tensor; the coordinator echoes it on ready
+        tensors so joined ranks can build zero participation);
+        ``members`` is a csv of the global ranks participating in the
+        collective ('' = every rank — † process-set readiness counts
+        member coverage only).
         ``joined``: this rank has no more inputs († RequestType::JOIN).
         """
         items = []
         for it in names:
             if isinstance(it, str):
                 items.append(it)
+                continue
+            name, meta, members = (it if len(it) == 3 else (*it, ""))
+            if members:
+                items.append(f"{name}\x02{meta}\x02{members}")
+            elif meta:
+                items.append(f"{name}\x02{meta}")
             else:
-                name, meta = it
-                items.append(f"{name}\x02{meta}" if meta else name)
+                items.append(name)
         blob = "\n".join(items).encode()
         cap = 1 << 20  # 1 MB of tensor names per round is far beyond real use
         buf = ctypes.create_string_buffer(cap)
@@ -287,6 +305,11 @@ class ControllerClient:
         n = self._lib.hvd_ctrl_negotiate(
             self._h, blob, 1 if joined else 0, buf, cap,
             ctypes.byref(all_joined), ctypes.byref(last_rank))
+        if n == -3:
+            raise ConnectionError(
+                "negotiation round aborted by the controller: another "
+                "rank stopped checking in (process died or engine "
+                "stalled-out)")
         if n < 0:
             raise ConnectionError("negotiation failed (controller gone?)")
         if n > cap:

@@ -36,7 +36,13 @@ class DistributedNegotiator(Negotiator):
             if e.name in seen:
                 continue
             seen.add(e.name)
-            pairs.append((e.name, e.meta()))
+            members = ""
+            if e.process_set is not None:
+                # † process_set.cc: readiness counts the member ranks
+                # only — without this, a subgroup collective would wait
+                # forever for ranks that never submit it.
+                members = ",".join(str(r) for r in e.process_set.ranks)
+            pairs.append((e.name, e.meta(), members))
         res = self._client.negotiate(pairs, joined=joined)
         for name in res.stalled:
             if name not in self._warned:

@@ -565,10 +565,15 @@ class KvClient {
 // Round protocol (client -> server frame):
 //   u32 rank, u8 flags (bit0: this rank has JOINed — no more inputs,
 //   † message.h RequestType::JOIN), u32 n_entries, then per entry either
-//     'N' + str name + str meta  (first sighting — server assigns an id;
+//     'N' + str name + str meta + str members
+//                                (first sighting — server assigns an id;
 //                                 meta is an opaque descriptor the engine
 //                                 uses to build zero-payload participation
-//                                 on joined ranks)
+//                                 on joined ranks; members is a csv of the
+//                                 global ranks that participate — "" means
+//                                 every rank.  † process_set.cc: a
+//                                 process-set collective is ready once its
+//                                 MEMBERS have submitted, not the world)
 //   or
 //     'I' + u32 id     (cache fast path † bit-vector exchange)
 // Server reply:
@@ -593,15 +598,46 @@ struct TensorState {
   uint32_t id;
   std::string name;
   std::string meta;
+  // Global ranks participating in this tensor's collective; empty = every
+  // rank († ProcessSet membership).  Readiness and join coverage are
+  // computed against this set.
+  std::set<uint32_t> members;
   std::set<uint32_t> ranks_seen;
   uint64_t first_seen_round;
   Clock::time_point first_seen_time;
 };
 
+// "0,2,5" -> {0, 2, 5} ("" -> {}).
+static std::set<uint32_t> parse_members(const std::string& csv) {
+  std::set<uint32_t> out;
+  size_t start = 0;
+  while (start < csv.size()) {
+    size_t comma = csv.find(',', start);
+    if (comma == std::string::npos) comma = csv.size();
+    if (comma > start) {
+      out.insert(static_cast<uint32_t>(
+          std::strtoul(csv.substr(start, comma - start).c_str(), nullptr,
+                       10)));
+    }
+    start = comma + 1;
+  }
+  return out;
+}
+
 class Controller {
  public:
-  Controller(int port, int size, int stall_warn_ms, std::string secret)
+  // round_abort_ms > 0: a rank waiting in the per-round barrier longer
+  // than this receives an abort reply instead of blocking forever — the
+  // escape hatch for "another rank's engine died/diverged mid-job"
+  // († the reference delivers an error Response to every rank so all
+  // raise; a blocked barrier would otherwise hold ranks in recv where
+  // their own stall inspectors cannot run).  0 disables (default): long
+  // legitimate rounds (first XLA compile) must not be aborted unless the
+  // operator opted into stall shutdown.
+  Controller(int port, int size, int stall_warn_ms, std::string secret,
+             int round_abort_ms = 0)
       : size_(static_cast<uint32_t>(size)), stall_warn_ms_(stall_warn_ms),
+        round_abort_ms_(round_abort_ms),
         secret_(std::move(secret)) {
     listen_fd_ = listen_on(port);
     if (listen_fd_ >= 0) {
@@ -659,14 +695,19 @@ class Controller {
       uint32_t rank = get_u32(frame, &off);
       uint8_t flags = static_cast<uint8_t>(frame[off++]);
       uint32_t n = get_u32(frame, &off);
-      std::vector<std::pair<std::string, std::string>> names;  // (name, meta)
+      struct NewEntry {
+        std::string name, meta, members;
+      };
+      std::vector<NewEntry> names;
       std::vector<uint32_t> ids;
       for (uint32_t i = 0; i < n; ++i) {
         char tag = frame[off++];
         if (tag == 'N') {
           std::string nm = get_str(frame, &off);
           std::string meta = get_str(frame, &off);
-          names.emplace_back(std::move(nm), std::move(meta));
+          std::string members = get_str(frame, &off);
+          names.push_back({std::move(nm), std::move(meta),
+                           std::move(members)});
         } else {
           ids.push_back(get_u32(frame, &off));
         }
@@ -678,7 +719,8 @@ class Controller {
         rank_fds_[rank] = fd;
       }
       // Record submissions.
-      for (auto& nm : names) RecordName(rank, nm.first, nm.second);
+      for (auto& nm : names)
+        RecordName(rank, nm.name, nm.meta, nm.members);
       for (uint32_t id : ids) RecordId(rank, id);
       if (flags & 1) {
         if (joined_.insert(rank).second) last_join_rank_ = rank;
@@ -686,6 +728,7 @@ class Controller {
       arrived_.insert(rank);
 
       uint64_t round = round_;
+      bool aborted = false;
       if (arrived_.size() == size_) {
         // Last arrival computes the response for everyone († rank-0
         // coordinator builds the response list once per round).
@@ -693,19 +736,40 @@ class Controller {
         arrived_.clear();
         round_++;
         cv_.notify_all();
+      } else if (round_abort_ms_ > 0) {
+        if (!cv_.wait_for(lk, std::chrono::milliseconds(round_abort_ms_),
+                          [&] { return round_ != round ||
+                                       stopping_.load(); })) {
+          // Some rank never checked in (engine dead / process gone):
+          // release THIS rank with an abort reply so its engine errors
+          // pending work instead of blocking in recv forever.  Withdraw
+          // this rank from the round entirely — a slow-but-alive last
+          // peer must not later complete the round counting us as a
+          // participant whose dispatch will never come.
+          aborted = true;
+          arrived_.erase(my_rank);
+          for (auto& kv : tensors_) kv.second.ranks_seen.erase(my_rank);
+          joined_.erase(my_rank);
+        }
       } else {
         cv_.wait(lk, [&] { return round_ != round || stopping_.load(); });
       }
       if (stopping_) break;
-      std::string reply = last_response_;
+      std::string reply;
+      if (aborted) {
+        put_u32(&reply, 0xFFFFFFFFu);  // round-abort sentinel
+      } else {
+        reply = last_response_;
+      }
       lk.unlock();
       send_auth_frame(fd, &ch, reply);
+      if (aborted) break;
     }
     ::close(fd);
   }
 
   void RecordName(uint32_t rank, const std::string& name,
-                  const std::string& meta) {
+                  const std::string& meta, const std::string& members) {
     auto it = by_name_.find(name);
     if (it == by_name_.end()) {
       uint32_t id = next_id_++;
@@ -713,6 +777,7 @@ class Controller {
       st.id = id;
       st.name = name;
       st.meta = meta;
+      st.members = parse_members(members);
       st.first_seen_round = round_;
       st.first_seen_time = Clock::now();
       st.ranks_seen.insert(rank);
@@ -728,6 +793,7 @@ class Controller {
       // what the submitting ranks hold this round is what lets joined and
       // live ranks agree on joinability.
       st.meta = meta;
+      st.members = parse_members(members);
       Touch(st, rank);
     }
   }
@@ -750,8 +816,15 @@ class Controller {
     st.ranks_seen.insert(rank);
   }
 
+  // Ranks whose participation a tensor needs: its member set, or the
+  // whole world when the member set is empty.
+  bool RankRequired(const TensorState& st, uint32_t r) const {
+    return st.members.empty() || st.members.count(r) != 0;
+  }
+
   void BuildResponse() {
-    // Ready = seen-or-joined on every rank; ordered by
+    // Ready = seen-or-joined on every REQUIRED rank (the member set for
+    // process-set tensors, the world otherwise); ordered by
     // (first_seen_round, id).  Joined ranks implicitly submit everything
     // († JoinOp: a joined rank participates as zeros).
     std::vector<const TensorState*> ready;
@@ -759,11 +832,17 @@ class Controller {
     auto now = Clock::now();
     for (auto& [id, st] : tensors_) {
       if (st.ranks_seen.empty()) continue;  // idle between cycles
-      size_t covered = st.ranks_seen.size();
-      for (uint32_t jr : joined_) {
-        if (!st.ranks_seen.count(jr)) ++covered;
+      size_t required = st.members.empty()
+                            ? size_
+                            : st.members.size();
+      size_t covered = 0;
+      for (uint32_t r : st.ranks_seen) {
+        if (RankRequired(st, r)) ++covered;
       }
-      if (covered == size_) {
+      for (uint32_t jr : joined_) {
+        if (RankRequired(st, jr) && !st.ranks_seen.count(jr)) ++covered;
+      }
+      if (covered == required) {
         ready.push_back(&st);
       } else if (stall_warn_ms_ > 0 &&
                  std::chrono::duration_cast<std::chrono::milliseconds>(
@@ -792,7 +871,7 @@ class Controller {
       // joined rank cannot take part in.
       uint8_t cov = 0;
       for (uint32_t jr : joined_) {
-        if (!st->ranks_seen.count(jr)) {
+        if (RankRequired(*st, jr) && !st->ranks_seen.count(jr)) {
           cov = 1;
           break;
         }
@@ -816,6 +895,7 @@ class Controller {
 
   uint32_t size_;
   int stall_warn_ms_;
+  int round_abort_ms_ = 0;
   std::string secret_;
   int listen_fd_ = -1;
   int port_ = -1;
@@ -861,12 +941,18 @@ class CtrlClient {
     bool join_cov;  // readiness depended on a joined rank's zero coverage
   };
 
-  // entries: (name, meta) for the tensors pending on this rank this round
-  // (meta travels only on first sighting; cached names go as ids).
+  struct Entry {
+    std::string name;
+    std::string meta;
+    std::string members;  // csv of participating ranks; "" = every rank
+  };
+
+  // entries: pending tensors on this rank this round (meta/members travel
+  // only on first sighting; cached names go as ids).
   // joined: this rank has no more inputs († RequestType::JOIN).
   // Returns the agreed globally-ready ordered list with each tensor's
   // meta + join-coverage flag, plus the all-joined signal.
-  bool Negotiate(const std::vector<std::pair<std::string, std::string>>& entries,
+  bool Negotiate(const std::vector<Entry>& entries,
                  bool joined,
                  std::vector<ReadyItem>* ready,
                  std::vector<std::string>* stalled, bool* all_joined,
@@ -876,18 +962,20 @@ class CtrlClient {
     msg += static_cast<char>(joined ? 1 : 0);
     put_u32(&msg, static_cast<uint32_t>(entries.size()));
     for (auto& e : entries) {
-      auto it = cache_.find(e.first);
-      // Id fast path only while the descriptor is unchanged; a meta change
-      // (e.g. tail batch with a new shape) must reach the server so joined
-      // ranks zero-participate with the current shape/dtype.
-      if (it != cache_.end() && meta_cache_[e.first] == e.second) {
+      auto it = cache_.find(e.name);
+      // Id fast path only while the descriptor is unchanged; a meta or
+      // membership change (e.g. tail batch with a new shape, or a name
+      // reused under a different process set) must reach the server.
+      std::string desc = e.meta + '\x01' + e.members;
+      if (it != cache_.end() && meta_cache_[e.name] == desc) {
         msg += 'I';
         put_u32(&msg, it->second);
       } else {
         msg += 'N';
-        put_str(&msg, e.first);
-        put_str(&msg, e.second);
-        meta_cache_[e.first] = e.second;
+        put_str(&msg, e.name);
+        put_str(&msg, e.meta);
+        put_str(&msg, e.members);
+        meta_cache_[e.name] = desc;
       }
     }
     std::string reply;
@@ -896,6 +984,10 @@ class CtrlClient {
       return false;
     size_t off = 0;
     uint32_t n_ready = get_u32(reply, &off);
+    if (n_ready == 0xFFFFFFFFu) {
+      round_aborted_ = true;  // † error Response: peer stopped checking in
+      return false;
+    }
     ready->clear();
     for (uint32_t i = 0; i < n_ready; ++i) {
       uint32_t id = get_u32(reply, &off);
@@ -916,11 +1008,13 @@ class CtrlClient {
   }
 
   size_t cache_size() const { return cache_.size(); }
+  bool round_aborted() const { return round_aborted_; }
 
  private:
   int fd_ = -1;
   uint32_t rank_;
   AuthChannel ch_;
+  bool round_aborted_ = false;
   std::unordered_map<std::string, uint32_t> cache_;
   std::unordered_map<std::string, std::string> meta_cache_;
 };
@@ -981,8 +1075,9 @@ void hvd_kv_close(void* c) { delete static_cast<KvClient*>(c); }
 
 // -- Controller --
 void* hvd_ctrl_server_start(int port, int size, int stall_warn_ms,
-                            const char* secret) {
-  auto* s = new Controller(port, size, stall_warn_ms, secret ? secret : "");
+                            const char* secret, int round_abort_ms) {
+  auto* s = new Controller(port, size, stall_warn_ms, secret ? secret : "",
+                           round_abort_ms);
   if (!s->ok()) {
     delete s;
     return nullptr;
@@ -1005,17 +1100,18 @@ void* hvd_ctrl_connect(const char* host, int port, int rank, int timeout_ms,
   return c;
 }
 
-// names_blob: '\n'-joined entries ('' = none), each "name" or
-// "name\x02meta".  joined: nonzero when this rank has JOINed.  On success
-// writes '\n'-joined ready entries ("name\x02meta", with "\x02j" appended
-// when readiness depended on a joined rank's zero coverage) then '\x01'
-// then '\n'-joined stalled names into out, sets *all_joined /
-// *last_join_rank, and returns total length (or required length if > cap;
-// -1 on failure).
+// names_blob: '\n'-joined entries ('' = none), each "name",
+// "name\x02meta", or "name\x02meta\x02members" (members: csv of
+// participating ranks, '' = every rank).  joined: nonzero when this rank
+// has JOINed.  On success writes '\n'-joined ready entries
+// ("name\x02meta", with "\x02j" appended when readiness depended on a
+// joined rank's zero coverage) then '\x01' then '\n'-joined stalled names
+// into out, sets *all_joined / *last_join_rank, and returns total length
+// (or required length if > cap; -1 on failure).
 int hvd_ctrl_negotiate(void* c, const char* names_blob, int joined_flag,
                        char* out, int cap, int* all_joined,
                        int* last_join_rank) {
-  std::vector<std::pair<std::string, std::string>> entries;
+  std::vector<CtrlClient::Entry> entries;
   {
     std::string blob(names_blob);
     size_t start = 0;
@@ -1024,12 +1120,22 @@ int hvd_ctrl_negotiate(void* c, const char* names_blob, int joined_flag,
       if (nl == std::string::npos) nl = blob.size();
       if (nl > start) {
         std::string item = blob.substr(start, nl - start);
+        CtrlClient::Entry e;
         size_t sep = item.find('\x02');
         if (sep == std::string::npos) {
-          entries.emplace_back(std::move(item), "");
+          e.name = std::move(item);
         } else {
-          entries.emplace_back(item.substr(0, sep), item.substr(sep + 1));
+          e.name = item.substr(0, sep);
+          std::string rest = item.substr(sep + 1);
+          size_t sep2 = rest.find('\x02');
+          if (sep2 == std::string::npos) {
+            e.meta = std::move(rest);
+          } else {
+            e.meta = rest.substr(0, sep2);
+            e.members = rest.substr(sep2 + 1);
+          }
         }
+        entries.push_back(std::move(e));
       }
       start = nl + 1;
     }
@@ -1038,9 +1144,10 @@ int hvd_ctrl_negotiate(void* c, const char* names_blob, int joined_flag,
   std::vector<std::string> stalled;
   bool aj = false;
   uint32_t last = 0;
-  if (!static_cast<CtrlClient*>(c)->Negotiate(entries, joined_flag != 0,
-                                              &ready, &stalled, &aj, &last))
-    return -1;
+  auto* client = static_cast<CtrlClient*>(c);
+  if (!client->Negotiate(entries, joined_flag != 0, &ready, &stalled, &aj,
+                         &last))
+    return client->round_aborted() ? -3 : -1;
   if (all_joined != nullptr) *all_joined = aj ? 1 : 0;
   if (last_join_rank != nullptr) *last_join_rank = static_cast<int>(last);
   std::string joined;

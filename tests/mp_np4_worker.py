@@ -1,0 +1,86 @@
+"""np=4 worker exercising the hard negotiated paths (round-2 verdict #5).
+
+Modes (``HVDTPU_TEST_MODE``):
+
+- ``train`` (default): fused/grouped allreduce over the real negotiated
+  transport, a process-set collective over ranks {0, 2} (readiness counts
+  member coverage only — the controller's per-tensor member list), and a
+  closing barrier.
+- ``stall``: ranks 0-2 submit a tensor rank 3 never does (the classic
+  rank-dependent-conditional divergence † stall_inspector.cc); every
+  submitting rank must get the stall warning followed by a
+  HorovodInternalError shutdown, while the diverged rank exits cleanly.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=1"
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import horovod_tpu as hvd  # noqa: E402
+
+
+def train_mode(me: int, n: int) -> int:
+    # 1. Many async allreduces in one burst: the cycle thread fuses them
+    # into grouped dispatches negotiated across all 4 processes.
+    hs = [hvd.allreduce_async(
+        hvd.from_local(np.full((1, 5), float(me + i), np.float32)),
+        hvd.Average, name=f"grad.{i}") for i in range(8)]
+    for i, h in enumerate(hs):
+        got = hvd.to_numpy(hvd.synchronize(h))
+        want = np.mean([r + i for r in range(n)])
+        assert np.allclose(got, want), (i, got, want)
+
+    # 2. Process-set collective over ranks {0, 2}: only members submit;
+    # the controller must mark it ready on member coverage alone.
+    ps = hvd.add_process_set([0, 2])
+    if me in (0, 2):
+        x = hvd.from_local(
+            np.full((1, 3), float(me + 1), np.float32), process_set=ps)
+        h = hvd.allreduce_async(x, hvd.Sum, name="ps.grad", process_set=ps)
+        got = hvd.to_numpy(hvd.synchronize(h))
+        assert np.allclose(got, 4.0), got    # (0+1) + (2+1)
+    hvd.remove_process_set(ps)
+
+    # 3. Barrier across the full world closes the phase.
+    hvd.barrier()
+    print(f"rank {me}: NP4-OK")
+    return 0
+
+
+def stall_mode(me: int, n: int) -> int:
+    if me < 3:
+        h = hvd.allreduce_async(
+            hvd.from_local(np.ones((1, 2), np.float32)),
+            name="t.diverged")
+        try:
+            hvd.synchronize(h)
+        except hvd.HorovodInternalError as e:
+            assert "stall" in str(e).lower(), e
+            print(f"rank {me}: STALL-ERR-OK")
+            return 0
+        print(f"rank {me}: FAIL no stall error")
+        return 1
+    # Rank 3 diverged (never submits); it must stay healthy and exit.
+    import time
+    time.sleep(6.0)
+    print(f"rank {me}: STALL-BYSTANDER-OK")
+    return 0
+
+
+def main() -> int:
+    hvd.init()
+    me, n = hvd.rank(), hvd.size()
+    assert n == 4, n
+    mode = os.environ.get("HVDTPU_TEST_MODE", "train")
+    rc = train_mode(me, n) if mode == "train" else stall_mode(me, n)
+    hvd.shutdown()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

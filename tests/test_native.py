@@ -383,6 +383,40 @@ def test_join_metadata_survives_cache_fast_path():
             c.close()
 
 
+def test_process_set_readiness_counts_members_only():
+    """A subgroup tensor is ready once its MEMBER ranks submitted — the
+    rest of the world never does († process_set.cc); without per-tensor
+    membership the round would wait forever."""
+    with ControllerServer(size=4) as srv:
+        clients = [ControllerClient("127.0.0.1", srv.port, r)
+                   for r in range(4)]
+        out = _round(clients, {0: [("ps.t", "", "0,2")],
+                               2: [("ps.t", "", "0,2")]})
+        for r in range(4):
+            assert out[r].ready == ["ps.t"], (r, out[r])
+        # A world tensor still needs everyone.
+        out = _round(clients, {0: ["t.w"], 2: ["t.w"]})
+        assert out[0].ready == []
+        out = _round(clients, {r: ["t.w"] for r in range(4)})
+        assert out[0].ready == ["t.w"]
+        for c in clients:
+            c.close()
+
+
+def test_round_abort_releases_waiting_rank():
+    """With round_abort_ms set, a rank whose peer never checks in gets an
+    abort error instead of blocking in the barrier forever (the escape
+    hatch that lets its engine fail pending work † error Response)."""
+    import time as _time
+    with ControllerServer(size=2, round_abort_ms=300) as srv:
+        c0 = ControllerClient("127.0.0.1", srv.port, 0)
+        t0 = _time.monotonic()
+        with pytest.raises(ConnectionError, match="aborted"):
+            c0.negotiate(["t0"])
+        assert _time.monotonic() - t0 < 5.0
+        c0.close()
+
+
 # ---------------------------------------------------------------------------
 # HMAC-authenticated control plane († runner/common/util/secret.py: per-job
 # shared secret signs every driver<->task RPC)
