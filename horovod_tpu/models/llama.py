@@ -48,6 +48,14 @@ class LlamaConfig:
     capacity_factor: float = 1.25
     remat: bool = True
     moe_aux_weight: float = 0.01
+    # Blockwise (online-softmax) cross-entropy (ops/losses.py): trades
+    # one extra lm_head matmul for never materializing the [B,S,V] fp32
+    # logits.  Measured on TPU v5 lite (d1024/L8, B=8, S=1024, V=32000):
+    # ~13% SLOWER than the dense path (XLA already streams the dense
+    # softmax well) but saves the ~1 GB logits+grad residency — so it is
+    # an opt-in memory lever for configs that don't otherwise fit, not a
+    # default.
+    blockwise_ce: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -366,10 +374,15 @@ def _forward_pipelined(params: dict, tokens: jax.Array, cfg: LlamaConfig,
 
 
 def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, *,
-            mesh: Optional[Mesh] = None, causal: bool = True
+            mesh: Optional[Mesh] = None, causal: bool = True,
+            return_hidden: bool = False
             ) -> tuple[jax.Array, jax.Array]:
-    """Logits for next-token prediction.  Returns (logits, moe_aux_loss)."""
+    """Logits for next-token prediction.  Returns (logits, moe_aux_loss);
+    with ``return_hidden`` the final normed hidden states ``[B,S,D]``
+    come back instead of logits (the blockwise-CE loss applies the
+    lm_head itself, vocab block by vocab block)."""
     if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        assert not return_hidden, "blockwise CE requires a pp=1 mesh"
         return _forward_pipelined(params, tokens, cfg, mesh, causal)
     B, S = tokens.shape
     h = params["embed"].astype(cfg.dtype)[tokens]           # [B,S,D]
@@ -408,10 +421,25 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, *,
     (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
                            params["layers"])
     h = _rmsnorm(h, params["final_norm"])
+    if return_hidden:
+        return h, aux
     logits = jnp.einsum("bsd,dv->bsv", h, params["lm_head"])
     if mesh is not None:
         logits = shd.constrain(logits, ("batch", "seq", "vocab"), mesh)
     return logits.astype(jnp.float32), aux
+
+
+def _use_blockwise_ce(cfg: LlamaConfig, mesh: Optional[Mesh]) -> bool:
+    if not cfg.blockwise_ce:
+        return False
+    if mesh is not None and (mesh.shape.get("tp", 1) > 1
+                             or mesh.shape.get("sp", 1) > 1
+                             or mesh.shape.get("pp", 1) > 1):
+        # tp shards the vocab dim and pp/sp restructure the forward; the
+        # blockwise scan currently assumes an unsharded lm_head column
+        # space.  dp/fsdp compose fine.
+        return False
+    return True
 
 
 def loss_fn(params: dict, batch: dict, cfg: LlamaConfig, *,
@@ -419,6 +447,15 @@ def loss_fn(params: dict, batch: dict, cfg: LlamaConfig, *,
     """Causal LM loss: batch = {"tokens": [B,S+1] int32}."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    if _use_blockwise_ce(cfg, mesh):
+        from ..ops.losses import blockwise_cross_entropy
+        h, aux = forward(params, inputs, cfg, mesh=mesh,
+                         return_hidden=True)
+        B, S, D = h.shape
+        nll = blockwise_cross_entropy(
+            h.reshape(B * S, D), params["lm_head"],
+            targets.reshape(-1).astype(jnp.int32))
+        return nll.mean() + cfg.moe_aux_weight * aux
     logits, aux = forward(params, inputs, cfg, mesh=mesh)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
