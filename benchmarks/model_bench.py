@@ -1,0 +1,134 @@
+"""BASELINE-config model benchmarks on the real chip.
+
+Measures the driver BASELINE.json target metrics beyond the flagship:
+ResNet-50 samples/sec/chip (config 2) and BERT-Large tokens/sec/chip
+(config 3) on synthetic data, single chip, appending records to
+``benchmarks/measured.jsonl``.
+
+    python benchmarks/model_bench.py [resnet] [bert]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _fence(tree):
+    import jax
+    leaf = jax.tree.leaves(tree)[0]
+    float(leaf.ravel()[0])  # host readback fences tunneled backends
+
+
+def _persist(rec: dict) -> None:
+    with open(os.path.join(REPO, "benchmarks", "measured.jsonl"), "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def bench_resnet(steps=20, warmup=3, B=128):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models.resnet import resnet50
+
+    model = resnet50()
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.rand(B, 224, 224, 3), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 1000, size=(B,)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), images, train=False)
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = jax.jit(tx.init)(params)
+
+    @jax.jit
+    def step(params, opt_state, images, labels):
+        def loss_fn(p):
+            logits, updates = model.apply(
+                p, images, train=True, mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+            return loss, updates
+        (loss, updates), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        upd, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, upd)
+        params = {**params, "batch_stats": updates["batch_stats"]}
+        return params, opt_state, loss
+
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+    _fence(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+    _fence(loss)
+    dt = time.perf_counter() - t0
+    dev = jax.devices()[0]
+    rec = {
+        "metric": f"resnet50_train_samples_per_sec_per_chip_"
+                  f"{jax.default_backend()}",
+        "value": round(B * steps / dt, 1), "unit": "samples/s/chip",
+        "batch": B, "image": [224, 224, 3],
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "loss": float(loss), "ts": time.time(),
+    }
+    print(json.dumps(rec))
+    _persist(rec)
+
+
+def bench_bert(steps=20, warmup=3, B=8, S=512):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models import bert
+
+    cfg = bert.BertConfig.bert_large()
+    model = bert.Bert(cfg)
+    batch = bert.synthetic_mlm_batch(cfg, B, S)
+    params = model.init(jax.random.PRNGKey(0), batch["tokens"])
+    tx = optax.adamw(1e-4)
+    opt_state = jax.jit(tx.init)(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: bert.mlm_loss(p, batch, model))(params)
+        upd, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, upd), opt_state, loss
+
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, batch)
+    _fence(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+    _fence(loss)
+    dt = time.perf_counter() - t0
+    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+    dev = jax.devices()[0]
+    rec = {
+        "metric": f"bert_large_mlm_tokens_per_sec_per_chip_"
+                  f"{jax.default_backend()}",
+        "value": round(B * S * steps / dt, 1), "unit": "tokens/s/chip",
+        "batch": B, "seq": S, "n_params": n_params,
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "loss": float(loss), "ts": time.time(),
+    }
+    print(json.dumps(rec))
+    _persist(rec)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1:] or ["resnet", "bert"]
+    if "resnet" in which:
+        bench_resnet()
+    if "bert" in which:
+        bench_bert()
