@@ -21,11 +21,7 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
-def _fence(out) -> None:
-    # Host readback of one element: block_until_ready alone can be a no-op
-    # on tunneled backends (same caveat as bench.py), so force a
-    # device->host fetch, which cannot complete before the computation.
-    float(out.ravel()[0])
+from benchmarks._common import fence as _fence, persist as _persist  # noqa: E402
 
 
 def _time_it(fn, *args, iters: int = 50, warmup: int = 3) -> float:
@@ -101,10 +97,8 @@ def run(seqs, persist: bool = True, causal: bool = True):
         records.append(rec)
         print(json.dumps(rec))
     if persist:
-        with open(os.path.join(REPO, "benchmarks", "measured.jsonl"),
-                  "a") as f:
-            for rec in records:
-                f.write(json.dumps(rec) + "\n")
+        for rec in records:
+            _persist(rec)
     return records
 
 

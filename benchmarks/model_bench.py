@@ -20,15 +20,7 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
-def _fence(tree):
-    import jax
-    leaf = jax.tree.leaves(tree)[0]
-    float(leaf.ravel()[0])  # host readback fences tunneled backends
-
-
-def _persist(rec: dict) -> None:
-    with open(os.path.join(REPO, "benchmarks", "measured.jsonl"), "a") as f:
-        f.write(json.dumps(rec) + "\n")
+from benchmarks._common import fence as _fence, persist as _persist  # noqa: E402
 
 
 def bench_resnet(steps=20, warmup=3, B=128):
