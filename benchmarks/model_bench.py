@@ -118,9 +118,75 @@ def bench_bert(steps=20, warmup=3, B=8, S=512):
     _persist(rec)
 
 
+def bench_dlrm(steps=20, warmup=3, B=8192):
+    """Config 5: DLRM with table-sharded embedding exchange (the
+    hvd.alltoall role).  Single chip runs the same shard_map path with
+    axis size 1; the exchange itself is exercised multi-device by
+    tests/test_models.py on the 8-device rig."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.models import dlrm
+
+    cfg = dlrm.DlrmConfig(
+        n_dense=13, n_sparse=26, vocab_per_table=100_000, embed_dim=64,
+        bottom_mlp=(512, 256, 64), top_mlp=(512, 256, 1),
+        dtype=jnp.bfloat16)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("hvd",))
+    model = dlrm.DlrmDense(cfg)
+    batch = dlrm.synthetic_batch(cfg, B)
+    tables = dlrm.init_embedding_tables(cfg, jax.random.PRNGKey(0))
+    demb0 = dlrm.sharded_embedding_lookup(tables, batch["sparse"], mesh)
+    params = model.init(jax.random.PRNGKey(1), batch["dense"], demb0)
+    tx = optax.adagrad(1e-2)   # the DLRM-standard optimizer
+    opt_state = jax.jit(tx.init)((params, tables))
+
+    @jax.jit
+    def step(params, tables, opt_state, batch):
+        def loss_fn(pt):
+            p, tb = pt
+            emb = dlrm.sharded_embedding_lookup(tb, batch["sparse"], mesh)
+            logits = model.apply(p, batch["dense"], emb)
+            return optax.sigmoid_binary_cross_entropy(
+                logits, batch["label"]).mean()
+        loss, grads = jax.value_and_grad(loss_fn)((params, tables))
+        upd, opt_state = tx.update(grads, opt_state, (params, tables))
+        params, tables = optax.apply_updates((params, tables), upd)
+        return params, tables, opt_state, loss
+
+    for _ in range(warmup):
+        params, tables, opt_state, loss = step(params, tables, opt_state,
+                                               batch)
+    _fence(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, tables, opt_state, loss = step(params, tables, opt_state,
+                                               batch)
+    _fence(loss)
+    dt = time.perf_counter() - t0
+    dev = jax.devices()[0]
+    rec = {
+        "metric": f"dlrm_train_samples_per_sec_per_chip_"
+                  f"{jax.default_backend()}",
+        "value": round(B * steps / dt, 1), "unit": "samples/s/chip",
+        "batch": B, "n_sparse": cfg.n_sparse,
+        "vocab_per_table": cfg.vocab_per_table,
+        "embed_dim": cfg.embed_dim,
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "loss": float(loss), "ts": time.time(),
+    }
+    print(json.dumps(rec))
+    _persist(rec)
+
+
 if __name__ == "__main__":
-    which = sys.argv[1:] or ["resnet", "bert"]
+    which = sys.argv[1:] or ["resnet", "bert", "dlrm"]
     if "resnet" in which:
         bench_resnet()
     if "bert" in which:
         bench_bert()
+    if "dlrm" in which:
+        bench_dlrm()
