@@ -101,6 +101,8 @@ def main():
         "ts": time.time(),
     }
     print(json.dumps(rec))
+    from benchmarks._common import persist
+    persist(rec)
     return rec
 
 

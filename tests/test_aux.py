@@ -173,7 +173,7 @@ def test_autotuner_proposes_and_converges(tmp_path):
 
 
 @pytest.mark.integration
-def test_autotune_improves_dispatch_bound_throughput():
+def test_autotune_improves_dispatch_bound_throughput(tmp_path):
     """Round-2 verdict #7: the GP+EI loop must beat a deliberately bad
     (threshold, cycle-time) start on a dispatch-bound gradient stream —
     committed evidence lives in benchmarks/autotune_log.txt and
@@ -185,7 +185,8 @@ def test_autotune_improves_dispatch_bound_throughput():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     res = subprocess.run(
         [sys.executable, os.path.join(repo, "benchmarks",
-                                      "autotune_bench.py")],
+                                      "autotune_bench.py"),
+         "--log", str(tmp_path / "autotune_log.txt")],
         capture_output=True, text=True, timeout=800, cwd=repo)
     assert res.returncode == 0, res.stdout + res.stderr
     rec = json.loads(res.stdout.strip().splitlines()[-1])
