@@ -92,8 +92,10 @@ def main(argv=None) -> dict:
                     help="autotune log path (default: the committed "
                          "benchmarks/autotune_log.txt; tests pass a "
                          "scratch path so CI never dirties the artifact)")
+    ap.add_argument("--no-persist", action="store_true",
+                    help="skip appending to benchmarks/measured.jsonl")
     args = ap.parse_args(argv)
-    evidence_mode = args.log is None
+    evidence_mode = not args.no_persist
     log_path = args.log or os.path.join(REPO, "benchmarks",
                                         "autotune_log.txt")
     if os.path.exists(log_path):

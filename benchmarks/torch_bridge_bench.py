@@ -85,7 +85,13 @@ def bench(bucket_cap_bytes):
             "step_ms": round(dt * 1e3, 2)}
 
 
-def main():
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--no-persist", action="store_true",
+                    help="skip appending to benchmarks/measured.jsonl "
+                         "(scratch/CI runs)")
+    args = ap.parse_args(argv)
     import horovod_tpu as hvd
     hvd.init()
     per_tensor = bench(bucket_cap_bytes=1)
@@ -101,8 +107,9 @@ def main():
         "ts": time.time(),
     }
     print(json.dumps(rec))
-    from benchmarks._common import persist
-    persist(rec)
+    if not args.no_persist:
+        from benchmarks._common import persist
+        persist(rec)
     return rec
 
 

@@ -80,7 +80,13 @@ def run(func: Callable[..., Any]) -> Callable[..., Any]:
 
     ``func(state, *args, **kwargs)`` is retried under the elastic protocol:
     ``HorovodInternalError`` → restore + reinit + on_reset;
-    ``HostsUpdatedInterrupt`` → sync and continue.
+    ``HostsUpdatedInterrupt`` → sync and continue (standalone), or exit
+    with the reserved restart code when running under the ElasticDriver
+    (``HVDTPU_ELASTIC=1``) — a static XLA mesh cannot absorb new hosts
+    in-process, so using added capacity means restarting the job on the
+    new assignment; the driver relaunches without blacklisting and the
+    state's last ``commit()`` (already durable before the interrupt is
+    raised) carries training across the restart.
     """
 
     @functools.wraps(func)
@@ -95,11 +101,31 @@ def run(func: Callable[..., Any]) -> Callable[..., Any]:
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError as e:
+                if os.environ.get("HVDTPU_ELASTIC") == "1":
+                    # Under the ElasticDriver the job — not the process —
+                    # is the recovery unit (static mesh + controller in
+                    # the launcher): exit so the driver relaunches
+                    # survivors from durable state (FileBackedState /
+                    # checkpoints).  The VICTIM code tells the driver
+                    # this rank observed a failure rather than caused
+                    # one, so its host is not blacklisted (a hung peer's
+                    # victims exit first and would otherwise be evicted).
+                    from ..runner.launch import VICTIM_EXIT_CODE
+                    log.warning(
+                        "elastic: collective failure (%s); exiting for "
+                        "driver relaunch", e)
+                    raise SystemExit(VICTIM_EXIT_CODE)
                 log.warning("elastic: collective failure (%s); rolling back "
                             "to last commit and re-initializing", e)
                 _reinitialize()
                 state.restore()
             except HostsUpdatedInterrupt as e:
+                if os.environ.get("HVDTPU_ELASTIC") == "1":
+                    from ..runner.launch import RESTART_EXIT_CODE
+                    log.info(
+                        "elastic: %s; exiting for a driver relaunch on "
+                        "the new assignment (state committed)", e)
+                    raise SystemExit(RESTART_EXIT_CODE)
                 log.info("elastic: %s; syncing state from rank 0", e)
                 state.sync()
 

@@ -161,17 +161,79 @@ class ElasticDriver:
         (ssh_port, verbose, connectivity_check, ...) to it.
         """
         if launcher is None:
-            from .launch import launch_workers
+            from .launch import (
+                RESTART_EXIT_CODE,
+                VICTIM_EXIT_CODE,
+                launch_workers,
+            )
 
             def launcher(cmd, hosts, env):
                 spec = ",".join(f"{h.hostname}:{h.slots}" for h in hosts)
                 np_total = min(sum(h.slots for h in hosts),
                                self.max_np or 10 ** 9)
                 failure: dict = {}
-                code = launch_workers(cmd, np_total=np_total,
-                                      hosts_spec=spec, extra_env=env,
-                                      failure_info=failure,
-                                      **(launch_kwargs or {}))
+                stop_watch = threading.Event()
+
+                def services_hook(services):
+                    # Growth watcher: while the job runs, poll discovery;
+                    # when total capacity exceeds the running np (and
+                    # max_np allows more), bump the membership epoch in
+                    # the job's KV store — workers exit with the restart
+                    # code at their next commit and we relaunch on the
+                    # grown assignment († WorkerNotificationService push).
+                    from .._native import KvClient
+                    from ..elastic.runner import WorkerNotificationClient
+
+                    def watch():
+                        grown_polls = 0
+                        while not stop_watch.wait(self._poll_interval):
+                            try:
+                                self.poll_hosts()
+                                with self._lock:
+                                    capacity = sum(
+                                        h.slots
+                                        for h in self._current_hosts)
+                                growable = (capacity > np_total
+                                            and np_total < (self.max_np
+                                                            or 10 ** 9))
+                                # Debounce (flaky discovery) and keep
+                                # re-bumping while grown: a bump landing
+                                # before a worker baselines its notifier
+                                # epoch would otherwise be absorbed
+                                # silently and the capacity never used.
+                                grown_polls = (grown_polls + 1
+                                               if growable else 0)
+                                if grown_polls >= 2:
+                                    kv = KvClient("127.0.0.1",
+                                                  services.kv.port,
+                                                  secret=services.secret)
+                                    WorkerNotificationClient.bump(kv)
+                                    kv.close()
+                                    log.info(
+                                        "elastic: capacity grew to %d "
+                                        "slots (running np=%d); signaled "
+                                        "workers to restart", capacity,
+                                        np_total)
+                            except Exception as e:
+                                log.warning(
+                                    "elastic: growth watcher error: %s", e)
+
+                    threading.Thread(target=watch, daemon=True,
+                                     name="hvdtpu-growth-watch").start()
+
+                try:
+                    code = launch_workers(cmd, np_total=np_total,
+                                          hosts_spec=spec, extra_env=env,
+                                          failure_info=failure,
+                                          services_hook=services_hook,
+                                          **(launch_kwargs or {}))
+                finally:
+                    stop_watch.set()
+                if code in (RESTART_EXIT_CODE, VICTIM_EXIT_CODE):
+                    # Voluntary membership restart, or a victim of some
+                    # other rank's fault: either way, the first-exiting
+                    # worker is not the culprit — no blacklist.
+                    return code
                 if code != 0 and failure.get("host") and len(hosts) > 1:
                     # † registration.py: exclude the crashed worker's host
                     # from the next assignment.  Sole-host jobs keep their
@@ -180,7 +242,10 @@ class ElasticDriver:
                     self.blacklist(failure["host"])
                 return code
 
+        from .launch import RESTART_EXIT_CODE
+
         restarts = 0
+        voluntary = 0
         while True:
             hosts = self.wait_for_available_slots(timeout_s=slot_timeout_s)
             epoch = self.membership_epoch
@@ -190,12 +255,26 @@ class ElasticDriver:
             code = launcher(list(command), hosts, env)
             if code == 0:
                 return 0
-            restarts += 1
+            if code == RESTART_EXIT_CODE:
+                # Voluntary membership restarts get their own (generous)
+                # budget: a flapping discovery script alternating the
+                # host list must not relaunch-loop the job forever.
+                voluntary += 1
+                if voluntary > max(10, max_restarts):
+                    log.warning(
+                        "elastic: %d voluntary restarts (flapping "
+                        "discovery?); counting further ones against the "
+                        "failure budget", voluntary)
+                    restarts += 1
+            else:
+                restarts += 1
             if restarts > max_restarts:
-                log.error("elastic: giving up after %d restarts", restarts)
+                log.error("elastic: giving up after %d restarts",
+                          restarts)
                 return code
-            # A nonzero exit means some worker died; refresh membership and
-            # let discovery/blacklist shape the next assignment.
+            # Refresh membership and let discovery/blacklist shape the
+            # next assignment (a grown host list enlarges it; a crashed
+            # host's blacklisting shrinks it).
             self.poll_hosts()
             if on_epoch_change and self.membership_epoch != epoch:
                 on_epoch_change(self.membership_epoch)

@@ -151,6 +151,19 @@ class _Worker:
         self.proc = proc
 
 
+# Reserved worker exit code: "membership changed — restart me on the new
+# assignment".  The monitor still tears the job down, but the elastic
+# driver relaunches without blacklisting anyone (a voluntary restart is
+# not a fault).  EX_TEMPFAIL by analogy.
+RESTART_EXIT_CODE = 75
+# Reserved worker exit code: "a collective failed UNDER me — I am a
+# victim of some other rank's fault, not the fault itself".  The driver
+# relaunches but must not blacklist this worker's host: with a hung
+# (never-exiting) peer, the victim's exit is the FIRST the monitor sees,
+# and blacklisting by first-exit would permanently evict a healthy host.
+VICTIM_EXIT_CODE = 76
+
+
 def launch_workers(command: Sequence[str], *, np_total: int,
                    hosts_spec: Optional[str] = None,
                    extra_env: Optional[dict] = None,
@@ -158,9 +171,14 @@ def launch_workers(command: Sequence[str], *, np_total: int,
                    verbose: bool = False,
                    prefix_output: bool = True,
                    connectivity_check: bool = True,
-                   failure_info: Optional[dict] = None) -> int:
+                   failure_info: Optional[dict] = None,
+                   services_hook=None) -> int:
     """Start services + workers; wait; return exit code.  Local ranks run as
-    child processes, remote ranks through ``ssh`` († gloo_run exec path)."""
+    child processes, remote ranks through ``ssh`` († gloo_run exec path).
+
+    ``services_hook(services)`` runs once the control-plane services are
+    up — the elastic driver uses it to reach the job's KV store for
+    membership-epoch notifications while the job runs."""
     from .cluster import DriverServices, pick_coordinator_port
 
     hosts = parse_hosts(hosts_spec) if hosts_spec else \
@@ -197,6 +215,11 @@ def launch_workers(command: Sequence[str], *, np_total: int,
     services = DriverServices(np_total, service_ip=service_ip,
                               secret=job_secret,
                               stall_shutdown_s=stall_shutdown_s)
+    if services_hook is not None:
+        try:
+            services_hook(services)
+        except Exception as e:  # the hook must never kill the launch
+            print(f"[launcher] services_hook failed: {e}", file=sys.stderr)
     if is_local_job:
         coord_port = _free_port()
         coord_host = "127.0.0.1"

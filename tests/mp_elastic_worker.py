@@ -1,21 +1,30 @@
-"""Worker for the elastic-CLI end-to-end test.
+"""Worker for the elastic-CLI end-to-end tests (shrink AND grow).
 
-Trains a toy "model" (a scalar advanced by negotiated allreduce) for
-TOTAL_STEPS, committing a :class:`FileBackedState` each step.  When run at
-size 2, rank 1 hard-crashes at step 3 *before* that step's collective —
-the launcher sees the nonzero exit, the ElasticDriver blacklists the
-crashed worker's host and relaunches at np=1, and the surviving worker
-resumes from the last committed step.  † ``test/integration/elastic``
-kill-a-worker scripts; the TPU adaptation restarts the job rather than
-patching the ring (see :mod:`horovod_tpu.runner.elastic`).
+Trains a toy "model" (a scalar advanced by negotiated allreduce) under
+the real elastic API — ``@hvd.elastic.run`` over a
+:class:`FileBackedState` committed each step — so the full protocol runs:
+commit → epoch check → ``HostsUpdatedInterrupt`` → restart-code exit
+(growth), and ``HorovodInternalError`` → nonzero exit → blacklist +
+relaunch (failure).  † ``test/integration/elastic`` worker scripts; the
+TPU adaptation restarts the job rather than patching a live ring
+(:mod:`horovod_tpu.runner.elastic`).
 
-Per-step arithmetic (so the test can assert exact continuity):
-``w <- allreduce_sum(w + 1)`` = ``size * (w + 1)`` — any lost or repeated
-step changes the final value.
+Env knobs:
+- ``HVDTPU_TEST_KILL=1``: at size 2, rank 1 hard-crashes at step 3
+  *before* that step's collective (the shrink scenario).
+- ``HVDTPU_TEST_STEP_DELAY``: seconds to sleep per step (gives the
+  driver's growth watcher time to fire in the grow scenario).
+- ``HVDTPU_TEST_TOTAL``: total steps (default 6).
+
+Per-step arithmetic (exact continuity checks):
+``w <- allreduce_sum(w + 1)`` = ``size * (w + 1)`` — at size 1, w after
+k steps is exactly k, so a grown relaunch must show ``resume w ==
+resume_step``.
 """
 
 import os
 import sys
+import time
 
 os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
     " --xla_force_host_platform_device_count=1"
@@ -24,9 +33,9 @@ jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.elastic as hvd_elastic  # noqa: E402
 from horovod_tpu.elastic import FileBackedState  # noqa: E402
 
-TOTAL_STEPS = 6
 KILL_STEP = 3
 
 
@@ -38,23 +47,35 @@ def log_line(path: str, text: str) -> None:
 def main() -> int:
     state_path = os.environ["HVDTPU_TEST_STATE"]
     log_path = os.environ["HVDTPU_TEST_LOG"]
+    total = int(os.environ.get("HVDTPU_TEST_TOTAL", "6"))
+    delay = float(os.environ.get("HVDTPU_TEST_STEP_DELAY", "0"))
+    kill = os.environ.get("HVDTPU_TEST_KILL") == "1"
     hvd.init()
     me, n = hvd.rank(), hvd.size()
     state = FileBackedState(state_path, step=0, w=0.0)
     log_line(log_path,
              f"START rank={me} size={n} resume_step={state.step} "
              f"w={state.w}")
-    for step in range(state.step, TOTAL_STEPS):
-        if n == 2 and me == 1 and step == KILL_STEP:
-            log_line(log_path, f"CRASH rank={me} step={step}")
-            os._exit(7)
-        x = hvd.from_local(np.full((1, 1), state.w + 1.0, np.float32))
-        out = hvd.to_numpy(hvd.synchronize(
-            hvd.allreduce_async(x, hvd.Sum, name=f"w.{step}")))
-        state.w = float(out[0])
-        state.step = step + 1
-        state.commit()
-        log_line(log_path, f"STEP rank={me} size={n} step={step} w={state.w}")
+
+    @hvd_elastic.run
+    def train(state):
+        for step in range(state.step, total):
+            if kill and n == 2 and me == 1 and step == KILL_STEP:
+                log_line(log_path, f"CRASH rank={me} step={step}")
+                os._exit(7)
+            if delay:
+                time.sleep(delay)
+            x = hvd.from_local(np.full((1, 1), state.w + 1.0, np.float32))
+            out = hvd.to_numpy(hvd.synchronize(
+                hvd.allreduce_async(x, hvd.Sum, name=f"w.{step}")))
+            state.w = float(out[0])
+            state.step = step + 1
+            state.commit()   # durable save, then epoch check (may exit 75)
+            log_line(log_path,
+                     f"STEP rank={me} size={n} step={step} w={state.w}")
+        return state.w
+
+    train(state)
     hvd.shutdown()
     log_line(log_path, f"DONE rank={me} size={n} step={state.step} "
                        f"w={state.w}")

@@ -186,7 +186,7 @@ def test_autotune_improves_dispatch_bound_throughput(tmp_path):
     res = subprocess.run(
         [sys.executable, os.path.join(repo, "benchmarks",
                                       "autotune_bench.py"),
-         "--log", str(tmp_path / "autotune_log.txt")],
+         "--log", str(tmp_path / "autotune_log.txt"), "--no-persist"],
         capture_output=True, text=True, timeout=800, cwd=repo)
     assert res.returncode == 0, res.stdout + res.stderr
     rec = json.loads(res.stdout.strip().splitlines()[-1])

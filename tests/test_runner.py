@@ -8,6 +8,7 @@ launcher end-to-end on localhost).
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -198,6 +199,7 @@ def test_hvdrun_elastic_kill_blacklist_relaunch(tmp_path):
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["HVDTPU_TEST_STATE"] = str(state)
     env["HVDTPU_TEST_LOG"] = str(log)
+    env["HVDTPU_TEST_KILL"] = "1"
     res = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
          "--min-np", "1", "--max-np", "2",
@@ -214,6 +216,64 @@ def test_hvdrun_elastic_kill_blacklist_relaunch(tmp_path):
     import json as _json
     final = _json.loads(state.read_text())
     assert final == {"step": 6, "w": 17.0}
+
+
+@pytest.mark.integration
+def test_hvdrun_elastic_grow_uses_new_host(tmp_path):
+    """Scale-UP circle: the job starts at np=1; mid-run the discovery
+    file gains a second host; the driver's growth watcher bumps the
+    membership epoch, the worker exits with the restart code at its next
+    commit, and the driver relaunches at np=2 — resuming from the last
+    commit (at size 1, w == step exactly) with both ranks training."""
+    hostsfile = tmp_path / "hosts.txt"
+    hostsfile.write_text("localhost:1\n")
+    discover = tmp_path / "discover.sh"
+    discover.write_text(f"#!/bin/sh\ncat {hostsfile}\n")
+    discover.chmod(0o755)
+    state = tmp_path / "state.json"
+    log = tmp_path / "train.log"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["HVDTPU_TEST_STATE"] = str(state)
+    env["HVDTPU_TEST_LOG"] = str(log)
+    env["HVDTPU_TEST_TOTAL"] = "40"
+    env["HVDTPU_TEST_STEP_DELAY"] = "0.4"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "1",
+         "--min-np", "1", "--max-np", "2",
+         "--host-discovery-script", str(discover), "--",
+         sys.executable, os.path.join(REPO, "tests", "mp_elastic_worker.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+    try:
+        # Let the np=1 incarnation commit a few steps, then add capacity.
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if log.exists() and sum(
+                    1 for ln in log.read_text().splitlines()
+                    if ln.startswith("STEP")) >= 3:
+                break
+            time.sleep(0.5)
+        hostsfile.write_text("localhost:1\n127.0.0.1:1\n")
+        out, _ = proc.communicate(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, out
+    lines = log.read_text().splitlines()
+    assert "START rank=0 size=1 resume_step=0 w=0.0" in lines
+    # The relaunch runs at size 2 and resumed from the exact commit
+    # (w == step at size 1).
+    restart = [ln for ln in lines
+               if ln.startswith("START rank=0 size=2 resume_step=")]
+    assert restart, lines
+    resumed = restart[0].split("resume_step=")[1].split()
+    assert float(resumed[1].split("=")[1]) == float(resumed[0]) > 0
+    assert any(ln.startswith("STEP rank=1 size=2") for ln in lines), lines
+    assert any(ln.startswith("DONE rank=0 size=2 step=40") for ln in lines)
+    import json as _json
+    assert _json.loads(state.read_text())["step"] == 40
 
 
 @pytest.mark.integration
