@@ -609,7 +609,15 @@ class CollectiveEngine:
                         tl.end_activity(e.name)
                     tl.start_activity(e.name, "DISPATCH")
                     e.tl_phase = "DISPATCH"
-            results = self._dispatch(group)
+            # Named span in device profiles too: `jax.profiler.trace()`
+            # captures show which collective a compiled program belongs
+            # to, complementing the host-side Chrome timeline
+            # († SURVEY aux: timeline + per-collective profiler spans).
+            from jax.profiler import TraceAnnotation
+            label = (group[0].name if len(group) == 1
+                     else f"hvd.fused[{len(group)}].{group[0].name}")
+            with TraceAnnotation(f"hvd.{group[0].verb}:{label}"):
+                results = self._dispatch(group)
             if tl is not None and tl.enabled:
                 for e in group:
                     tl.end_activity(e.name)
