@@ -19,9 +19,9 @@ Layout choices (see /opt/skills/guides/pallas_guide.md):
   inputs stay bf16.
 
 Measured on TPU v5 lite vs XLA's fused dense attention (bf16,
-B=4,H=16,D=64, causal), forward+backward — the training shape: 1.06x at
-S=512, 1.57x at 1024, 2.31x at 2048, 4.74x at 4096 (forward alone: 1.18x /
-1.28x / 1.89x / 6.85x).  Data committed in ``benchmarks/measured.jsonl``;
+B=4,H=16,D=64, causal), forward+backward — the training shape: 1.0x at
+S=512, 1.64x at 1024, 2.46x at 2048, 4.9x at 4096 (forward alone: 0.9x /
+1.51x / 1.95x / 6.92x).  Data committed in ``benchmarks/measured.jsonl``;
 reproduce with ``python benchmarks/flash_bench.py``.
 """
 
@@ -303,11 +303,13 @@ def _flash_backward(q, k, v, out, lse, g, *, scale, causal, block_q,
 # ---------------------------------------------------------------------------
 
 def default_blocks(seq_len: int) -> tuple[int, int]:
-    """Large query blocks amortize per-program cost; bq=512/bk=1024 gave
-    the best measured times on TPU v5 lite (data in
+    """Large query blocks amortize per-program cost; a fwd+bwd block
+    sweep on TPU v5 lite found bq=512/bk=512 fastest at every measured
+    sequence length (S=1024: 4.54 ms vs 4.94 with the old bk=1024;
+    S=4096: 14.3 vs 15.2 — the ``flash_block_sweep`` record in
     benchmarks/measured.jsonl)."""
     bq = next((b for b in (512, 256, 128) if seq_len % b == 0), None)
-    bk = next((b for b in (1024, 512, 256, 128) if seq_len % b == 0), None)
+    bk = next((b for b in (512, 256, 128) if seq_len % b == 0), None)
     return bq or 128, bk or 128
 
 

@@ -53,7 +53,7 @@ def test_flash_gradients_match_dense():
 
 
 def test_default_blocks_divisibility():
-    assert default_blocks(1024) == (512, 1024)
+    assert default_blocks(1024) == (512, 512)
     assert default_blocks(256) == (256, 256)
     assert default_blocks(384) == (128, 128)
 
