@@ -308,9 +308,8 @@ def default_blocks(seq_len: int) -> tuple[int, int]:
     sequence length (S=1024: 4.54 ms vs 4.94 with the old bk=1024;
     S=4096: 14.3 vs 15.2 — the ``flash_block_sweep`` record in
     benchmarks/measured.jsonl)."""
-    bq = next((b for b in (512, 256, 128) if seq_len % b == 0), None)
-    bk = next((b for b in (512, 256, 128) if seq_len % b == 0), None)
-    return bq or 128, bk or 128
+    b = next((c for c in (512, 256, 128) if seq_len % c == 0), 128)
+    return b, b  # two-tuple API: callers may still override bq/bk apart
 
 
 def supported(q_shape: tuple, itemsize: int = 4) -> bool:

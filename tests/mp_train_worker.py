@@ -71,13 +71,22 @@ def main() -> int:
     assert np.allclose(got, expected), (me, got, expected)
 
     # 6. non-uniform alltoall († MPI_Alltoallv): per-rank splits differ.
-    my_splits = [1, 2] if me == 0 else [2, 1]
-    send = np.arange(3, dtype=np.float32) + 10.0 * me
+    # Works at any np: source i sends 1 + ((i + j) % 2) rows to rank j.
+    def splits_of(i):
+        return [1 + ((i + j) % 2) for j in range(n)]
+
+    my_splits = splits_of(me)
+    send = np.arange(sum(my_splits), dtype=np.float32) + 10.0 * me
     recv = hvd.alltoall([send], splits=np.array([my_splits], np.int32))
-    # rank r receives splits_i[r] rows from each source i, source-ordered:
-    # rank0 gets send0[:1] + send1[:2]; rank1 gets send0[1:] + send1[2:].
-    want = (np.array([0.0, 10.0, 11.0], np.float32) if me == 0
-            else np.array([1.0, 2.0, 12.0], np.float32))
+    # rank r receives splits_i[r] rows from each source i, source-ordered,
+    # each source's rows starting at sum(splits_i[:r]) of its send buffer.
+    want_parts = []
+    for i in range(n):
+        sp = splits_of(i)
+        start = sum(sp[:me])
+        want_parts.append(
+            np.arange(start, start + sp[me], dtype=np.float32) + 10.0 * i)
+    want = np.concatenate(want_parts)
     got_a2a = hvd.to_numpy(recv[0])
     assert np.allclose(got_a2a, want), (me, got_a2a, want)
 

@@ -98,11 +98,16 @@ def _hvdrun(np_, script_args, timeout=240, extra_env=None):
 
 
 @pytest.mark.integration
-def test_hvdrun_two_process_collectives():
-    res = _hvdrun(2, [os.path.join(REPO, "tests", "mp_train_worker.py")])
+@pytest.mark.parametrize("np_", [2, 8])
+def test_hvdrun_collective_battery(np_):
+    """The full verb battery over real negotiated transport — at the
+    historical 2-process rig and at np=8 (controller round-barrier,
+    fused grouped dispatch, ragged allgatherv, non-uniform alltoallv)."""
+    res = _hvdrun(np_, [os.path.join(REPO, "tests", "mp_train_worker.py")],
+                  timeout=120 + 30 * np_)
     assert res.returncode == 0, res.stdout + res.stderr
-    assert "rank 0: OK" in res.stdout
-    assert "rank 1: OK" in res.stdout
+    for r in range(np_):
+        assert f"rank {r}: OK" in res.stdout, res.stdout
 
 
 @pytest.mark.integration
