@@ -155,6 +155,54 @@ def test_multichip_dryrun_no_involuntary_remat():
         ln[:200] for ln in bad)
 
 
+def test_flash_model_path_matches_dense_on_mesh():
+    """The TPU-gated flash branch of the model's sharded attention (the
+    dp/fsdp/tp shard_map in ``_attention``) must produce the same loss
+    and gradients as the dense path — exercised on the CPU rig through
+    the Pallas interpreter via the ``_FORCE_FLASH_INTERPRET`` hook.
+    (The pp pipeline deliberately stays dense: flash under the tick
+    loop's ppermute/masked writes produced wrong gradients when probed —
+    see the comment in ``_forward_pipelined``.)"""
+    from horovod_tpu.models import llama as L
+
+    mesh = build_mesh(MeshConfig(dp=4, tp=2))
+    # Shapes satisfying FA.supported on the LOCAL view: S=256 (block
+    # 256), heads 4 / tp 2, head_dim 64.
+    cfg = llama.LlamaConfig.tiny(
+        n_layers=2, d_model=256, n_heads=4, n_kv_heads=4,
+        d_ff=256, vocab_size=128)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), mesh)
+    tokens = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(8, 257))
+    batch = jax.device_put(
+        {"tokens": jnp.asarray(tokens, jnp.int32)},
+        NamedSharding(mesh, P(("dp", "fsdp"))))
+
+    def loss_and_grads(force_flash):
+        old = L._FORCE_FLASH_INTERPRET
+        L._FORCE_FLASH_INTERPRET = force_flash
+        try:
+            fn = jax.jit(jax.value_and_grad(
+                lambda p: llama.loss_fn(p, batch, cfg, mesh=mesh)))
+            loss, grads = fn(params)
+            return float(loss), jax.device_get(grads)
+        finally:
+            L._FORCE_FLASH_INTERPRET = old
+
+    loss_f, grads_f = loss_and_grads(True)
+    loss_d, grads_d = loss_and_grads(False)
+    np.testing.assert_allclose(loss_f, loss_d, rtol=1e-5)
+    flat_f = {jax.tree_util.keystr(k): v
+              for k, v in jax.tree.leaves_with_path(grads_f)}
+    flat_d = {jax.tree_util.keystr(k): v
+              for k, v in jax.tree.leaves_with_path(grads_d)}
+    assert flat_f.keys() == flat_d.keys()
+    for key in flat_f:
+        np.testing.assert_allclose(
+            np.asarray(flat_f[key]), np.asarray(flat_d[key]),
+            rtol=2e-3, atol=2e-4, err_msg=key)
+
+
 def test_pp_rejects_sp_and_moe():
     mesh = build_mesh(MeshConfig(pp=2, sp=2, dp=2))
     cfg = llama.LlamaConfig.tiny()
