@@ -610,6 +610,20 @@ def gloo_built() -> bool:
     return native_built()
 
 
+def gloo_enabled() -> bool:
+    """† ``gloo_enabled``: the native transport is the only (and therefore
+    always-enabled) control plane when built."""
+    return gloo_built()
+
+
+def is_homogeneous() -> bool:
+    """True when every process drives the same number of devices
+    († ``horovod_is_homogeneous``: equal local sizes on all hosts —
+    heterogeneous jobs disable some fusion fast paths upstream)."""
+    from .context import cross_size, local_size, size
+    return size() == local_size() * cross_size()
+
+
 def nccl_built() -> int:
     """XLA's ICI/DCN collectives fill NCCL's role (int like the reference,
     which returns the NCCL version or 0)."""
@@ -649,4 +663,8 @@ def __getattr__(name: str):
     if name == "elastic":
         import importlib
         return importlib.import_module("horovod_tpu.elastic")
+    if name == "run_func":
+        # † ``horovod.run`` — programmatic function launcher.
+        from .runner.api import run_func
+        return run_func
     raise AttributeError(f"module 'horovod_tpu' has no attribute {name!r}")

@@ -5,5 +5,6 @@ per-rank env injection, ssh fan-out, monitor/kill.  Public API parity:
 ``horovod_tpu.runner.run(fn_cmd, np=...)`` mirrors ``horovod.run``.
 """
 
+from .api import run_func  # noqa: F401
 from .hosts import HostSlots, parse_hosts  # noqa: F401
 from .launch import main, run  # noqa: F401

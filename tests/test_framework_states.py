@@ -163,3 +163,9 @@ def test_capability_queries():
     assert hvd.nccl_built() == 1
     # native .so ships in-tree; gloo-role transport mirrors its presence
     assert hvd.gloo_built() == hvd.native_built()
+    assert hvd.gloo_enabled() == hvd.gloo_built()
+
+
+def test_is_homogeneous(hvd_session):
+    # Single-controller rig: one process drives all devices -> homogeneous.
+    assert hvd.is_homogeneous() is True
