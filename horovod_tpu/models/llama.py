@@ -46,7 +46,14 @@ class LlamaConfig:
     use_moe: bool = False
     n_experts: int = 8
     capacity_factor: float = 1.25
-    remat: bool = True
+    # Rematerialization of the layer body: True = full per-layer remat
+    # (least memory), False = save everything (fastest at the bench shape
+    # once trivial-mesh sharding constraints stopped fragmenting the
+    # saved-buffer fusions: TPU v5 lite in-process A/B 92.1 ms/step vs
+    # 93.7 "dots" vs ~98.8 full remat), or "dots" = jax.checkpoint with
+    # the dots_with_no_batch_dims_saveable policy — the memory/speed
+    # middle ground for configs that don't fit with remat=False.
+    remat: Any = True
     moe_aux_weight: float = 0.01
     # Blockwise (online-softmax) cross-entropy (ops/losses.py): trades
     # one extra lm_head matmul for never materializing the [B,S,V] fp32
@@ -166,34 +173,66 @@ def init_params(cfg: LlamaConfig, key: jax.Array, mesh: Optional[Mesh] = None
     return jax.jit(build, out_shardings=shardings)(key)
 
 
+def _remat(body, mode):
+    """Apply the configured rematerialization mode to a layer body."""
+    if mode == "dots":
+        return jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    return jax.checkpoint(body) if mode else body
+
+
 def _rmsnorm(x: jax.Array, w: jax.Array, eps: float = 1e-5) -> jax.Array:
     x32 = x.astype(jnp.float32)
     rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
     return (x32 * rms * w).astype(x.dtype)
 
 
-def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    # x: [B, S, H, Dh]; positions: [B, S]
-    B, S, H, Dh = x.shape
-    half = Dh // 2
+def _rope_tables(positions: jax.Array, theta: float, head_dim: int
+                 ) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables [B, S, half] for these positions.  Computed once per
+    forward and threaded through the layer scan as loop invariants rather
+    than re-deriving the transcendentals per layer.  (Measured step-time
+    effect on TPU v5 lite: none — XLA was already amortizing the
+    recompute — but the hoist keeps the scanned body minimal.)"""
+    half = head_dim // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
-    cos = jnp.cos(angles)[:, :, None, :]
-    sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rope(x: jax.Array, rope: tuple[jax.Array, jax.Array]) -> jax.Array:
+    # x: [B, S, H, Dh]; rope: (cos, sin) each [B, S, Dh//2]
+    half = x.shape[-1] // 2
+    cos, sin = rope[0][:, :, None, :], rope[1][:, :, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(x.dtype)
 
 
-def _attn_block(h, lp, positions, cfg: LlamaConfig, attention):
+def _embed_lookup(embed: jax.Array, tokens: jax.Array, dtype) -> jax.Array:
+    """Token embedding as a one-hot matmul rather than a gather: exact
+    (each one-hot row has a single nonzero), and the backward becomes a
+    transposed matmul on the MXU instead of a scatter-add.  In-process
+    A/B at the bench shape measured the two forms equal on TPU v5 lite
+    (XLA fuses the one-hot into the dot, and lowers the small-vocab
+    gather well); the matmul form is kept because it partitions cleanly
+    under the vocab_rows (tp, fsdp) sharding — a sharded gather lowers
+    to per-shard lookup + select + psum anyway."""
+    onehot = jax.nn.one_hot(tokens, embed.shape[0], dtype=dtype)
+    return jnp.einsum("bsv,vd->bsd", onehot, embed.astype(dtype))
+
+
+def _attn_block(h, lp, rope, cfg: LlamaConfig, attention):
     """Shared attention sub-block: RMSNorm -> QKV -> RoPE -> GQA expand ->
     ``attention`` callable -> output projection + residual."""
     x = _rmsnorm(h, lp["attn_norm"])
     q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"])
     k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"])
     v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"])
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    q = _rope(q, rope)
+    k = _rope(k, rope)
     if cfg.n_kv_heads != cfg.n_heads:                  # GQA expand
         rep = cfg.n_heads // cfg.n_kv_heads
         k = jnp.repeat(k, rep, axis=2)
@@ -209,9 +248,9 @@ def _dense_mlp(x2, lp):
 
 
 # Test hook: route the TPU-gated flash branches through the Pallas
-# interpreter so the CPU rig can exercise the exact shard_map structure
-# the TPU path uses (the dp/fsdp/tp map in `_attention`; the pp pipeline
-# deliberately stays dense — see `_forward_pipelined`).
+# interpreter so the CPU rig can exercise the exact structures the TPU
+# path uses (the dp/fsdp/tp shard_map in `_attention` and the direct
+# kernel call inside the fully-manual pipeline region).
 _FORCE_FLASH_INTERPRET = False
 
 
@@ -305,15 +344,140 @@ def _moe_mlp(h2, lp, cfg: LlamaConfig, mesh: Optional[Mesh]):
 
 
 def _pick_microbatches(batch: int, mesh: Mesh) -> int:
-    """Most microbatches <= 2*pp that divide the batch and keep each
-    microbatch divisible by the data axes (GPipe bubble (S-1)/(M+S-1);
-    callers with large batches get M = 2*pp)."""
+    """Most microbatches <= 2*pp that divide the LOCAL batch (GPipe
+    bubble (S-1)/(M+S-1); callers with large batches get M = 2*pp).  The
+    microbatch split happens inside the manual region on per-device
+    arrays, so M must divide batch/(dp*fsdp*ep); ep counts as a data axis
+    there so MoE dispatch sees distinct local tokens per ep rank."""
     pp = mesh.shape.get("pp", 1)
-    df = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-    for m in range(min(2 * pp, batch), 0, -1):
-        if batch % m == 0 and (batch // m) % df == 0:
+    df = (mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+          * mesh.shape.get("ep", 1))
+    if batch % df:
+        raise ValueError(
+            f"global batch {batch} must divide over dp*fsdp*ep = {df}")
+    local = batch // df
+    for m in range(min(2 * pp, local), 0, -1):
+        if local % m == 0:
             return m
     return 1
+
+
+def _pp_machinery(cfg: LlamaConfig, mesh: Mesh, causal: bool, S: int) -> dict:
+    """Shared layer-stack machinery for the pipelined paths (GPipe forward
+    and 1F1B training): the fully-manual layer body with Megatron-tp psums,
+    ZeRO-3 fsdp gathers, ring attention over sp, MoE over ep — and the
+    in/out specs matching the at-rest parameter shardings."""
+    pp = mesh.shape["pp"]
+    tp = mesh.shape.get("tp", 1)
+    sp = mesh.shape.get("sp", 1)
+    if cfg.n_layers % pp:
+        raise ValueError(
+            f"pp={pp} must divide n_layers={cfg.n_layers} evenly")
+    if cfg.n_heads % tp or cfg.n_kv_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide n_heads={cfg.n_heads} and "
+            f"n_kv_heads={cfg.n_kv_heads}")
+    if S % sp:
+        raise ValueError(f"sp={sp} must divide sequence length {S}")
+    from ..ops import flash_attention as FA
+
+    S_loc = S // sp
+    rep = cfg.n_heads // cfg.n_kv_heads
+    scale = 1.0 / np.sqrt(cfg.head_dim)
+    layer_dims = {k: d[1:]
+                  for k, d in param_logical_dims(cfg)["layers"].items()}
+
+    def gather_layer(lp):
+        # ZeRO-3 gather: reassemble the embed dim of this layer's weights
+        # from their fsdp shards; transpose = reduce-scatter of the grads.
+        out = {}
+        for k, leaf in lp.items():
+            for i, dname in enumerate(layer_dims[k]):
+                if dname == "embed":
+                    leaf = lax.all_gather(leaf, "fsdp", axis=i, tiled=True)
+            out[k] = leaf
+        return out
+
+    def attention(q, k, v):
+        if sp > 1:
+            return ring_attention_local(q, k, v, axis_name="sp",
+                                        causal=causal)
+        if _flash_backend() and FA.supported(q.shape, q.dtype.itemsize):
+            return FA.flash_attention(q, k, v, None, causal, None, None,
+                                      _FORCE_FLASH_INTERPRET)
+        from ..ops.flash_attention import dense_attention
+        return dense_attention(q, k, v, scale, causal)
+
+    def moe_mlp_local(x2, lp):
+        Bq, Sq, Dq = x2.shape
+        flat = x2.reshape(Bq * Sq, Dq)
+
+        def expert_fn(w, x):
+            g = jax.nn.silu(x @ w["w_gate"])
+            u = x @ w["w_up"]
+            return lax.psum((g * u) @ w["w_down"], "tp")
+
+        eparams = {"w_gate": lp["w_gate"], "w_up": lp["w_up"],
+                   "w_down": lp["w_down"]}
+        out, aux = moe_layer_local(
+            flat, lp["router"].astype(jnp.float32), expert_fn, eparams,
+            axis_name="ep", capacity_factor=cfg.capacity_factor)
+        # pmean includes tp (a forward no-op — aux is tp-replicated) so the
+        # aux gradient path is 1/tp-scaled per rank; the 1F1B step blanket-
+        # psums replicated-param grads over tp, and without this the
+        # routing-only aux path (which unlike the CE path has no tp-sharded
+        # op on it) would count tp times.
+        return (out.reshape(Bq, Sq, Dq),
+                lax.pmean(aux, ("dp", "fsdp", "ep", "sp", "tp")))
+
+    def layer_body(h, lp, rope):
+        lp = gather_layer(lp)
+        x = _rmsnorm(h, lp["attn_norm"])
+        q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"])     # heads local (tp)
+        k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"])
+        q = _rope(q, rope)
+        k = _rope(k, rope)
+        if rep != 1:                                      # GQA expand
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        attn_out = jnp.einsum("bshk,hkd->bsd", attention(q, k, v), lp["wo"])
+        h = h + lax.psum(attn_out, "tp")                  # row-parallel wo
+        x2 = _rmsnorm(h, lp["mlp_norm"])
+        if cfg.use_moe:
+            mlp_out, aux = moe_mlp_local(x2, lp)
+        else:
+            mlp_out = lax.psum(_dense_mlp(x2, lp), "tp")  # row-parallel
+            aux = jnp.zeros((), jnp.float32)
+        return h + mlp_out, aux
+
+    body = _remat(layer_body, cfg.remat)
+
+    def make_stage_fn(rope):
+        def stage_fn(local_layers, x):
+            # One pp rank's resident layers applied in sequence (scan: one
+            # compiled body regardless of depth).
+            def scan_body(carry, lp):
+                hc, aux = carry
+                hc, a = body(hc, lp, rope)
+                return (hc, aux + a), None
+
+            (out, aux), _ = lax.scan(
+                scan_body, (x, jnp.zeros((), jnp.float32)), local_layers)
+            return out, aux
+
+        return stage_fn
+
+    layer_specs = jax.tree.map(
+        lambda dims: shd.spec_for(dims), param_logical_dims(cfg)["layers"],
+        is_leaf=lambda x: isinstance(x, tuple))
+    return {
+        "make_stage_fn": make_stage_fn,
+        "layer_specs": layer_specs,
+        "layer_dims": layer_dims,
+        "act_spec": P(("dp", "fsdp", "ep"), "sp", None),
+        "S_loc": S_loc,
+    }
 
 
 def _forward_pipelined(params: dict, tokens: jax.Array, cfg: LlamaConfig,
@@ -327,70 +491,63 @@ def _forward_pipelined(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     GSPMD all-gather every layer's weights each step, turning the one axis
     meant to tolerate DCN into a per-layer DCN fetch).
 
-    The pipeline shard_map is manual over pp only; dp/fsdp/tp stay
-    automatic, so Megatron-style tp sharding inside each stage still
-    compiles to GSPMD collectives.  sp/ep run their own manual collectives
-    and currently require pp=1 meshes.
+    The pipeline shard_map is manual over ALL mesh axes (round-4 redesign:
+    the previous pp-only-manual version nested a flash shard_map on the
+    auto axes, whose gradients through the tick loop came out 1.4x off —
+    full-manual removes the nesting entirely).  Inside the region the
+    parallelism axes compose explicitly, Megatron-style:
+
+    - tp: heads/mlp-hidden locally sliced, one ``psum`` after each row-
+      parallel projection (wo, w_down);
+    - fsdp: ZeRO-3 — weights arrive sharded on the embed dim and are
+      ``all_gather``-ed per layer at use (re-gathered in the backward under
+      remat), gradients exit via the all_gather transpose (reduce-scatter);
+    - sp: ring attention (``ring_attention_local``) with RoPE positions
+      offset per sp rank;
+    - ep: the microbatch is sharded over dp×fsdp×ep so each ep rank owns
+      distinct tokens, and MoE dispatch is ``moe_layer_local``'s a2a;
+    - dp: pure batch sharding; weight-grad psums over replicated axes come
+      from the shard_map transpose.
+
+    Attention runs the Pallas flash kernel on TPU when the LOCAL shard
+    shape supports it (direct call — no nested shard_map), ring attention
+    when sp>1, dense XLA otherwise.
     """
-    pp = mesh.shape["pp"]
-    if cfg.use_moe or mesh.shape.get("sp", 1) > 1:
-        raise NotImplementedError(
-            "pp>1 composes with dp/fsdp/tp; sp and ep (MoE) axes need a "
-            "pp=1 mesh — their manual collectives don't nest inside the "
-            "pipeline's pp-manual shard_map yet")
-    if cfg.n_layers % pp:
-        raise ValueError(
-            f"pp={pp} must divide n_layers={cfg.n_layers} evenly")
-    from ..ops.flash_attention import dense_attention
+    parts = _pp_machinery(cfg, mesh, causal, tokens.shape[1])
+    make_stage_fn, S_loc = parts["make_stage_fn"], parts["S_loc"]
     from ..parallel.pipeline import pipeline_apply_local
 
     B, S = tokens.shape
     D = cfg.d_model
-    h = params["embed"].astype(cfg.dtype)[tokens]           # [B,S,D]
+    h = _embed_lookup(params["embed"], tokens, cfg.dtype)   # [B,S,D]
     h = shd.constrain(h, ("batch", "seq", None), mesh)
     M = _pick_microbatches(B, mesh)
-    mb = B // M
-    positions = jnp.broadcast_to(jnp.arange(S), (mb, S))
 
-    # Attention inside the pp-manual region runs DENSE, deliberately.  A
-    # nested flash shard_map over the auto dp/tp axes (built on the
-    # context AbstractMesh) does compile and its FORWARD matches dense,
-    # but gradients through the pipeline tick loop (ppermute handoffs +
-    # masked output writes, check_vma=False) come out wrong — probed
-    # round 3: dx off by 1.4x relative with the real
-    # pipeline_apply_local machinery while the same nested structure
-    # under a plain lax.scan matches dense to 4e-7.  Until that
-    # partial-manual AD interaction is resolved upstream, dense XLA
-    # einsums (GSPMD-partitioned on the auto axes) are the correct
-    # choice; this costs perf at long S on pp meshes, never correctness.
-    def attention(q, k, v):
-        return dense_attention(q, k, v, 1.0 / np.sqrt(cfg.head_dim), causal)
+    def local(local_layers, h_loc):
+        # The microbatch split happens HERE, on the local shard: splitting
+        # [B,S,D] -> [M,mb,S,D] outside the shard_map moves the batch
+        # sharding onto the microbatch dim across a reshape GSPMD cannot
+        # follow (involuntary full rematerialization at the boundary —
+        # caught by the round-4 verify drive).
+        B_loc = h_loc.shape[0]
+        mbs = h_loc.reshape(M, B_loc // M, S_loc, D)
+        # RoPE tables once per step (tick-invariant), not per tick.
+        base = lax.axis_index("sp") * S_loc + jnp.arange(S_loc)
+        positions = jnp.broadcast_to(base[None, :], (B_loc // M, S_loc))
+        rope = _rope_tables(positions, cfg.rope_theta, cfg.head_dim)
+        out, aux = pipeline_apply_local(make_stage_fn(rope), local_layers,
+                                        mbs, axis_name="pp", with_aux=True)
+        return out.reshape(B_loc, S_loc, D), aux
 
-    def layer_body(h, lp):
-        h = _attn_block(h, lp, positions, cfg, attention)
-        return h + _dense_mlp(_rmsnorm(h, lp["mlp_norm"]), lp)
-
-    body = jax.checkpoint(layer_body) if cfg.remat else layer_body
-
-    def stage_fn(local_layers, x):
-        # One pp rank's resident layers applied in sequence (scan: one
-        # compiled body regardless of depth).
-        out, _ = lax.scan(lambda c, lp: (body(c, lp), None), x, local_layers)
-        return out
-
-    def local(local_layers, mbs):
-        return pipeline_apply_local(stage_fn, local_layers, mbs,
-                                    axis_name="pp")
-
-    hmb = h.reshape(M, mb, S, D)
-    layer_specs = jax.tree.map(lambda _: P("pp"), params["layers"])
-    fn = shard_map(local, mesh=mesh, in_specs=(layer_specs, P()),
-                   out_specs=P(), axis_names={"pp"}, check_vma=False)
-    h = fn(params["layers"], hmb).reshape(B, S, D)
+    layer_specs, act_spec = parts["layer_specs"], parts["act_spec"]
+    fn = shard_map(local, mesh=mesh, in_specs=(layer_specs, act_spec),
+                   out_specs=(act_spec, P()), check_vma=False)
+    h, aux = fn(params["layers"], h)
+    h = shd.constrain(h, ("batch", "seq", None), mesh)
     h = _rmsnorm(h, params["final_norm"])
     logits = jnp.einsum("bsd,dv->bsv", h, params["lm_head"])
     logits = shd.constrain(logits, ("batch", "seq", "vocab"), mesh)
-    return logits.astype(jnp.float32), jnp.zeros((), jnp.float32)
+    return logits.astype(jnp.float32), aux
 
 
 def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, *,
@@ -405,9 +562,10 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, *,
         assert not return_hidden, "blockwise CE requires a pp=1 mesh"
         return _forward_pipelined(params, tokens, cfg, mesh, causal)
     B, S = tokens.shape
-    h = params["embed"].astype(cfg.dtype)[tokens]           # [B,S,D]
+    h = _embed_lookup(params["embed"], tokens, cfg.dtype)   # [B,S,D]
     h = shd.constrain(h, ("batch", "seq", None), mesh) if mesh else h
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    rope = _rope_tables(positions, cfg.rope_theta, cfg.head_dim)
     if mesh is not None:
         # Per-layer rule shardings for the scanned slices (leading "stage"
         # dim dropped).  Pinning the slices inside the body stops GSPMD's
@@ -422,7 +580,7 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, *,
         if mesh is not None:
             lp = {k: shd.constrain(v, layer_dims[k], mesh)
                   for k, v in lp.items()}
-        h = _attn_block(h, lp, positions, cfg,
+        h = _attn_block(h, lp, rope, cfg,
                         lambda q, k, v: _attention(q, k, v, mesh, causal))
         x2 = _rmsnorm(h, lp["mlp_norm"])
         if cfg.use_moe:
@@ -435,9 +593,7 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, *,
             h = shd.constrain(h, ("batch", "seq", None), mesh)
         return (h, aux), None
 
-    body = layer_body
-    if cfg.remat:
-        body = jax.checkpoint(layer_body)
+    body = _remat(layer_body, cfg.remat)
     (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
                            params["layers"])
     h = _rmsnorm(h, params["final_norm"])
@@ -477,17 +633,180 @@ def loss_fn(params: dict, batch: dict, cfg: LlamaConfig, *,
             targets.reshape(-1).astype(jnp.int32))
         return nll.mean() + cfg.moe_aux_weight * aux
     logits, aux = forward(params, inputs, cfg, mesh=mesh)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean() + cfg.moe_aux_weight * aux
+    # logsumexp form of the CE — identical math to log_softmax + gather,
+    # but the [B,S,V] fp32 log-prob tensor is never materialized, only
+    # its row reduction (memory win; step time measured equal on TPU).
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return (lse - picked).mean() + cfg.moe_aux_weight * aux
 
 
-def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx):
-    """Jitted full training step over the mesh (GSPMD collectives for
-    dp/fsdp/tp, explicit shard_map blocks for sp/ep; layer stack over pp)."""
+def _make_train_step_1f1b(cfg: LlamaConfig, mesh: Mesh, tx):
+    """Training step for pp>1 meshes on the 1F1B schedule
+    (:func:`horovod_tpu.parallel.pipeline.pipeline_train_local`).
+
+    Unlike the GPipe path (autodiff through the forward tick loop, all M
+    microbatch activations live at the fwd/bwd boundary), this computes
+    gradients EXPLICITLY inside the manual region: the loss head (final
+    norm + lm_head + CE over the tp-sharded vocab) runs on the last stage
+    per microbatch, cotangents ride ``ppermute`` back up the pipeline, and
+    at most 2*(pp-1) microbatch inputs are ever in flight.  The embedding
+    sits outside the region; its gradient comes from the returned input
+    cotangent via ``jax.vjp``.
+
+    Gradient accounting inside the manual region (no shard_map AD here, so
+    every reduction is explicit):
+    - the CE seed is 1/(dp*fsdp*ep*sp) so per-shard local means sum to the
+      global batch mean;
+    - each parameter gradient is psummed over exactly the mesh axes its
+      at-rest sharding does NOT mention (fsdp-sharded leaves already
+      reduce-scatter through the all_gather transpose);
+    - the input cotangent is psummed over tp (every tp rank's program
+      contributes the gradient through its own head/vocab slice).
+    """
+    from ..parallel.pipeline import pipeline_train_local
+
+    pp = mesh.shape["pp"]
+    data_axes = ("dp", "fsdp", "ep", "sp")
+    n_data = 1
+    for a in data_axes:
+        n_data *= mesh.shape.get(a, 1)
     pshard = param_shardings(cfg, mesh)
     repl = NamedSharding(mesh, P())
     batch_shard = NamedSharding(mesh, P(("dp", "fsdp")))
+    head_dims = {"lm_head": param_logical_dims(cfg)["lm_head"],
+                 "final_norm": param_logical_dims(cfg)["final_norm"]}
+    head_specs = {k: shd.spec_for(d) for k, d in head_dims.items()}
+    all_axes = ("dp", "fsdp", "ep", "sp", "tp")
+
+    def reduce_grads(grads, specs):
+        # psum each leaf over every axis its sharding does not mention.
+        def red(g, spec):
+            axes = tuple(a for a in all_axes
+                         if a not in shd.spec_axes(spec))
+            return lax.psum(g, axes) if axes else g
+        return jax.tree.map(red, grads, specs,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def step(params, opt_state, batch):
+        tokens = batch["tokens"]
+        inputs = tokens[:, :-1]
+        targets = tokens[:, 1:].astype(jnp.int32)
+        B, S = inputs.shape
+        D = cfg.d_model
+        parts = _pp_machinery(cfg, mesh, True, S)
+        make_stage_fn, S_loc = parts["make_stage_fn"], parts["S_loc"]
+        M = _pick_microbatches(B, mesh)
+
+        def embed_fn(emb):
+            h = _embed_lookup(emb, inputs, cfg.dtype)
+            return shd.constrain(h, ("batch", "seq", None), mesh)
+
+        h, embed_vjp = jax.vjp(embed_fn, params["embed"])
+        head_in = {"lm_head": params["lm_head"],
+                   "final_norm": params["final_norm"]}
+
+        def local(layers_loc, head_loc, h_loc, tgt_loc):
+            B_loc = h_loc.shape[0]
+            mb_loc = B_loc // M
+            mbs = h_loc.reshape(M, mb_loc, S_loc, D)
+            tgts = tgt_loc.reshape(M, mb_loc, S_loc)
+            base = lax.axis_index("sp") * S_loc + jnp.arange(S_loc)
+            positions = jnp.broadcast_to(base[None, :], (mb_loc, S_loc))
+            rope = _rope_tables(positions, cfg.rope_theta, cfg.head_dim)
+
+            # lm_head fsdp gather ONCE per step, outside the tick loop
+            # (XLA does not hoist collectives out of while loops); its
+            # grad reduce-scatters back once at the end.
+            head_full = {
+                "lm_head": lax.all_gather(head_loc["lm_head"], "fsdp",
+                                          axis=0, tiled=True),  # [D, V/tp]
+                "final_norm": head_loc["final_norm"],
+            }
+
+            def loss_head(head, y, m):
+                h2 = _rmsnorm(y, head["final_norm"])
+                logits = jnp.einsum("bsd,dv->bsv", h2, head["lm_head"]
+                                    ).astype(jnp.float32)
+                # CE over the tp-sharded vocab.  The max shift is taken on
+                # stopped gradients (exact: the shift cancels in the lse
+                # derivative) and reduced with all_gather+max — pmax has
+                # no AD rule even on zero tangents.
+                mloc = jnp.max(jax.lax.stop_gradient(logits), axis=-1)
+                mx = jnp.max(
+                    lax.all_gather(mloc, "tp", axis=0, tiled=False), axis=0)
+                lse = jnp.log(lax.psum(
+                    jnp.sum(jnp.exp(logits - mx[..., None]), axis=-1),
+                    "tp")) + mx
+                t = tgts[m]
+                vloc = logits.shape[-1]
+                vstart = lax.axis_index("tp") * vloc
+                within = (t >= vstart) & (t < vstart + vloc)
+                pl = jnp.take_along_axis(
+                    logits, jnp.clip(t - vstart, 0, vloc - 1)[..., None],
+                    axis=-1)[..., 0]
+                picked = lax.psum(jnp.where(within, pl, 0.0), "tp")
+                return (lse - picked).mean()
+
+            loss, aux, dmbs, dlayers, dhead = pipeline_train_local(
+                make_stage_fn(rope), layers_loc, mbs, loss_head, head_full,
+                axis_name="pp", aux_weight=cfg.moe_aux_weight,
+                seed_scale=1.0 / n_data)
+            loss = lax.pmean(loss, data_axes)
+            dh = lax.psum(dmbs.reshape(B_loc, S_loc, D), "tp")
+            dlayers = reduce_grads(dlayers, parts["layer_specs"])
+            # Undo the step-level gather: reduce-scatter the full-embed
+            # lm_head grad back to this rank's fsdp shard (the all_gather
+            # transpose), then psum over the remaining unmentioned axes.
+            dhead = {
+                "lm_head": lax.psum_scatter(
+                    dhead["lm_head"], "fsdp", scatter_dimension=0,
+                    tiled=True),
+                "final_norm": dhead["final_norm"],
+            }
+            dhead = reduce_grads(dhead, head_specs)
+            return loss, aux, dh, dlayers, dhead
+
+        fn = shard_map(
+            local, mesh=mesh,
+            in_specs=(parts["layer_specs"], head_specs, parts["act_spec"],
+                      P(("dp", "fsdp", "ep"), "sp")),
+            out_specs=(P(), P(), parts["act_spec"], parts["layer_specs"],
+                       head_specs),
+            check_vma=False)
+        loss, aux, dh, dlayers, dhead = fn(params["layers"], head_in, h,
+                                           targets)
+        (d_embed,) = embed_vjp(dh.astype(h.dtype))
+        grads = {"embed": d_embed, "layers": dlayers,
+                 "lm_head": dhead["lm_head"],
+                 "final_norm": dhead["final_norm"]}
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree.map(jnp.add, params, updates)
+        return params, opt_state, loss + cfg.moe_aux_weight * aux
+
+    return jax.jit(step, in_shardings=(pshard, None, batch_shard),
+                   out_shardings=(pshard, None, repl),
+                   donate_argnums=(0, 1))
+
+
+def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx, *,
+                    pipeline_schedule: str = "1f1b"):
+    """Jitted full training step over the mesh (GSPMD collectives for
+    dp/fsdp/tp, explicit shard_map blocks for sp/ep; layer stack over pp).
+
+    On pp>1 meshes ``pipeline_schedule`` selects "1f1b" (default: explicit
+    interleaved fwd/bwd schedule, activation memory bounded by 2*(pp-1)
+    microbatches) or "gpipe" (autodiff through the fill-drain forward)."""
+    if mesh.shape.get("pp", 1) > 1 and pipeline_schedule == "1f1b":
+        if cfg.blockwise_ce:
+            raise NotImplementedError("blockwise CE requires a pp=1 mesh")
+        return _make_train_step_1f1b(cfg, mesh, tx)
+    pshard = param_shardings(cfg, mesh)
+    repl = NamedSharding(mesh, P())
+    batch_shard = NamedSharding(mesh, P(("dp", "fsdp")))
+
+    multi_device = any(s > 1 for s in mesh.shape.values())
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(
@@ -495,8 +814,11 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx):
         # Pin gradients to the parameter shardings: the backward scan's
         # per-layer dynamic-update-slice accumulators otherwise get
         # propagation-derived shardings that force involuntary full
-        # rematerialization on the way into the optimizer update.
-        grads = jax.lax.with_sharding_constraint(grads, pshard)
+        # rematerialization on the way into the optimizer update.  (On a
+        # single-device mesh the annotation is a no-op semantically and
+        # only an XLA fusion barrier, so it is skipped.)
+        if multi_device:
+            grads = jax.lax.with_sharding_constraint(grads, pshard)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = jax.tree.map(jnp.add, params, updates)
         return params, opt_state, loss

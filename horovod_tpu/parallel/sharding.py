@@ -58,12 +58,30 @@ def logical_sharding(mesh: Mesh, logical_dims: Sequence[Optional[str]],
     return NamedSharding(mesh, spec_for(logical_dims, rules))
 
 
+def spec_axes(spec: P) -> set:
+    """The set of mesh axis names a PartitionSpec references."""
+    out = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        out.update((entry,) if isinstance(entry, str) else entry)
+    return out
+
+
 def constrain(x: jax.Array, logical_dims: Sequence[Optional[str]],
               mesh: Optional[Mesh] = None,
               rules: Optional[dict] = None) -> jax.Array:
-    """``with_sharding_constraint`` by logical dimension names."""
+    """``with_sharding_constraint`` by logical dimension names.
+
+    When every mesh axis the spec references has size 1 the constraint is
+    semantically a no-op (the tensor is unsharded either way) and is
+    skipped: the annotation is an optimization barrier to XLA fusion, so
+    leaving it in costs real step time on single-device meshes.
+    """
     spec = spec_for(logical_dims, rules)
     if mesh is not None:
+        if all(mesh.shape.get(a, 1) == 1 for a in spec_axes(spec)):
+            return x
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, spec))
     return jax.lax.with_sharding_constraint(x, spec)

@@ -97,12 +97,38 @@ def test_train_step_moe_ep():
     assert losses[-1] < losses[0], f"no learning: {losses}"
 
 
-def test_pp_pipeline_matches_dp_oracle():
-    """pp>1 runs the real GPipe schedule (stage-resident params,
-    ppermute'd activations) and must be loss-equivalent to plain DP."""
+@pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+def test_pp_pipeline_matches_dp_oracle(schedule):
+    """pp>1 runs a real pipeline schedule (stage-resident params,
+    ppermute'd activations) and must be loss-equivalent to plain DP —
+    both the GPipe autodiff path and the explicit-gradient 1F1B path."""
     dp_losses, _, _ = _train_losses(MeshConfig(dp=8), n_steps=3)
-    pp_losses, _, _ = _train_losses(MeshConfig(pp=2, dp=2, tp=2), n_steps=3)
+    pp_losses, _, _ = _train_losses(MeshConfig(pp=2, dp=2, tp=2), n_steps=3,
+                                    schedule=schedule)
     np.testing.assert_allclose(dp_losses, pp_losses, rtol=1e-4)
+
+
+def test_pp_1f1b_activation_memory_below_gpipe():
+    """The 1F1B selling point, asserted on the compiled step: with many
+    microbatches the GPipe step's temporary-buffer footprint grows with M
+    while 1F1B's stays bounded by 2*(pp-1) in-flight microbatches."""
+    mesh = build_mesh(MeshConfig(pp=2, dp=2, tp=2))
+    cfg = llama.LlamaConfig.tiny(n_layers=4, remat=True)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), mesh)
+    tx = optax.adam(1e-2)
+    opt_state = jax.jit(tx.init)(params)
+    batch = jax.device_put(_batch(cfg, B=32, S=32),
+                           NamedSharding(mesh, P(("dp", "fsdp"))))
+
+    def temp_bytes(schedule):
+        step = llama.make_train_step(cfg, mesh, tx,
+                                     pipeline_schedule=schedule)
+        comp = step.lower(params, opt_state, batch).compile()
+        return comp.memory_analysis().temp_size_in_bytes
+
+    t_1f1b, t_gpipe = temp_bytes("1f1b"), temp_bytes("gpipe")
+    assert t_1f1b < t_gpipe, (
+        f"1f1b temp {t_1f1b} not below gpipe temp {t_gpipe}")
 
 
 def test_pp_pipeline_no_per_layer_param_gather():
@@ -160,9 +186,7 @@ def test_flash_model_path_matches_dense_on_mesh():
     dp/fsdp/tp shard_map in ``_attention``) must produce the same loss
     and gradients as the dense path — exercised on the CPU rig through
     the Pallas interpreter via the ``_FORCE_FLASH_INTERPRET`` hook.
-    (The pp pipeline deliberately stays dense: flash under the tick
-    loop's ppermute/masked writes produced wrong gradients when probed —
-    see the comment in ``_forward_pipelined``.)"""
+    (The pp-mesh counterpart is ``test_pp_flash_attention_matches_dense``.)"""
     from horovod_tpu.models import llama as L
 
     mesh = build_mesh(MeshConfig(dp=4, tp=2))
@@ -203,22 +227,124 @@ def test_flash_model_path_matches_dense_on_mesh():
             rtol=2e-3, atol=2e-4, err_msg=key)
 
 
-def test_pp_rejects_sp_and_moe():
-    mesh = build_mesh(MeshConfig(pp=2, sp=2, dp=2))
-    cfg = llama.LlamaConfig.tiny()
-    params = llama.init_params(cfg, jax.random.PRNGKey(0))
-    tok = jnp.asarray(np.zeros((4, 8), np.int32))
-    with pytest.raises(NotImplementedError, match="pp=1"):
-        llama.forward(params, tok, cfg, mesh=mesh)
+def test_pp_sp_matches_dp_oracle():
+    """pp×sp composition: ring attention inside the fully-manual pipeline
+    region must be loss-equivalent to plain DP (round-3 verdict gap —
+    long-context on pipeline meshes)."""
+    dp_losses, _, _ = _train_losses(MeshConfig(dp=8), n_steps=3)
+    ppsp_losses, _, _ = _train_losses(MeshConfig(pp=2, sp=2, dp=2),
+                                      n_steps=3)
+    np.testing.assert_allclose(dp_losses, ppsp_losses, rtol=1e-3)
 
 
-def _train_losses(mesh_cfg, n_steps=4, seed=0):
+def test_pp_ep_moe_trains():
+    """pp×ep composition: MoE a2a dispatch inside the pipeline region.
+    Capacity dropping depends on token sharding, so exact oracle equality
+    is not defined — assert stable learning like the ep-only MoE test."""
+    mesh = build_mesh(MeshConfig(pp=2, ep=2, dp=2))
+    cfg = llama.LlamaConfig.tiny(use_moe=True, n_experts=4,
+                                 capacity_factor=2.0)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), mesh)
+    tx = optax.adam(1e-2)
+    opt_state = jax.jit(tx.init)(params)
+    step = llama.make_train_step(cfg, mesh, tx)
+    batch = jax.device_put(_batch(cfg, B=8, S=32),
+                           NamedSharding(mesh, P(("dp", "fsdp"))))
+    losses = []
+    for _ in range(10):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"no learning: {losses}"
+
+
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(pp=2, ep=2, dp=2),
+    MeshConfig(pp=2, ep=2, tp=2),
+])
+def test_pp_moe_1f1b_matches_gpipe(mesh_cfg):
+    """Gradient-correctness oracle for MoE on pp meshes: the 1F1B explicit-
+    gradient path must produce the same loss TRAJECTORY as the GPipe
+    autodiff path (same params, same batch, same routing) — with a large
+    aux weight so any aux-gradient mis-scaling diverges by step 2 (the
+    round-4 review found exactly that: an n_data-times aux overcount that
+    'loss decreases' tests cannot catch)."""
+    mesh = build_mesh(mesh_cfg)
+    cfg = llama.LlamaConfig.tiny(use_moe=True, n_experts=4,
+                                 capacity_factor=2.0, moe_aux_weight=0.5)
+    tx = optax.adam(1e-2)
+    batch = jax.device_put(_batch(cfg, B=8, S=32),
+                           NamedSharding(mesh, P(("dp", "fsdp"))))
+
+    def run(schedule):
+        params = llama.init_params(cfg, jax.random.PRNGKey(0), mesh)
+        opt_state = jax.jit(tx.init)(params)
+        step = llama.make_train_step(cfg, mesh, tx,
+                                     pipeline_schedule=schedule)
+        losses = []
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+        return losses
+
+    np.testing.assert_allclose(run("1f1b"), run("gpipe"), rtol=1e-4)
+
+
+def test_pp_flash_attention_matches_dense():
+    """Flash attention under pp (direct kernel call in the fully-manual
+    pipeline region — the round-3 1.4x-gradient bug is gone): loss AND
+    grads must match the dense path on the same pp mesh."""
+    from horovod_tpu.models import llama as L
+
+    from horovod_tpu.ops import flash_attention as FA
+
+    mesh = build_mesh(MeshConfig(pp=2, dp=2, tp=2))
+    cfg = llama.LlamaConfig.tiny(
+        n_layers=2, d_model=256, n_heads=4, n_kv_heads=4,
+        d_ff=256, vocab_size=128)
+    # Guard against vacuity: the LOCAL shard shape (mb/dpf, S, H/tp, Dh)
+    # must actually take the flash branch, or both runs silently go dense.
+    assert FA.supported((1, 256, 2, 64), itemsize=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), mesh)
+    tokens = np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                              size=(8, 257))
+    batch = jax.device_put(
+        {"tokens": jnp.asarray(tokens, jnp.int32)},
+        NamedSharding(mesh, P(("dp", "fsdp"))))
+
+    def loss_and_grads(force_flash):
+        old = L._FORCE_FLASH_INTERPRET
+        L._FORCE_FLASH_INTERPRET = force_flash
+        try:
+            fn = jax.jit(jax.value_and_grad(
+                lambda p: llama.loss_fn(p, batch, cfg, mesh=mesh)))
+            loss, grads = fn(params)
+            return float(loss), jax.device_get(grads)
+        finally:
+            L._FORCE_FLASH_INTERPRET = old
+
+    loss_f, grads_f = loss_and_grads(True)
+    loss_d, grads_d = loss_and_grads(False)
+    np.testing.assert_allclose(loss_f, loss_d, rtol=1e-5)
+    flat_f = {jax.tree_util.keystr(k): v
+              for k, v in jax.tree.leaves_with_path(grads_f)}
+    flat_d = {jax.tree_util.keystr(k): v
+              for k, v in jax.tree.leaves_with_path(grads_d)}
+    assert flat_f.keys() == flat_d.keys()
+    for key in flat_f:
+        np.testing.assert_allclose(
+            np.asarray(flat_f[key]), np.asarray(flat_d[key]),
+            rtol=5e-3, atol=5e-4, err_msg=key)
+
+
+def _train_losses(mesh_cfg, n_steps=4, seed=0, schedule="1f1b"):
     mesh = build_mesh(mesh_cfg)
     cfg = llama.LlamaConfig.tiny()
     params = llama.init_params(cfg, jax.random.PRNGKey(seed), mesh)
     tx = optax.adam(1e-2)
     opt_state = jax.jit(tx.init)(params)
-    step = llama.make_train_step(cfg, mesh, tx)
+    step = llama.make_train_step(cfg, mesh, tx,
+                                 pipeline_schedule=schedule)
     batch = jax.device_put(_batch(cfg, B=8, S=32, seed=seed),
                            NamedSharding(mesh, P(("dp", "fsdp"))))
     losses = []
