@@ -36,12 +36,16 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 
-# tokens/sec/chip anchors per platform.  The tpu figure is the round-3
-# measurement on the dev TPU v5 lite chip (86370.4 tok/s/chip, MFU 0.57 —
-# first record in benchmarks/measured.jsonl); vs_baseline therefore reads
-# as "improvement over the committed round-3 measurement".
+# tokens/sec/chip anchors per platform.  The tpu figure is the MEDIAN of
+# the round-4 variance study: six back-to-back runs of the round-3 code on
+# the dev TPU v5 lite chip measured 81246/81295/81484/81491/81495/82957
+# tok/s/chip (median 81487, spread ±1%; the ``variance_study`` record in
+# benchmarks/measured.jsonl).  The round-3 anchor of 86370 was that
+# session's single best-ever run and proved unreproducible (five later
+# runs all landed 6-9% below it), so vs_baseline now reads "improvement
+# over the reproducible round-3 median".
 BENCH_BASELINE = {
-    "tpu": 86370.4,
+    "tpu": 81487.0,
     "cpu": 9200.0,
 }
 
@@ -127,14 +131,18 @@ def worker(platform: str) -> None:
     flops_per_token = 6.0 * n_params + 12.0 * cfg.n_layers * cfg.d_model * S
     mfu = (per_chip * flops_per_token) / _peak_flops(device_kind)
 
-    # Allreduce bus-bandwidth point on the same mesh (16 MB payload).
+    # Allreduce point on the same mesh (16 MB payload).  With n>1 ranks
+    # this is bus bandwidth; at n=1 there is no wire, so it is labeled as
+    # dispatch throughput (round-3 verdict: no number may claim to be bus
+    # bandwidth without N>1).
     busbw = None
     try:
         import horovod_tpu as hvd
         from benchmarks.collective_bench import allreduce_busbw
         hvd.init()
         pt = allreduce_busbw(1 << 24, iters=10, warmup=2)
-        busbw = {"busbw_GBs": round(pt["busbw_GBs"], 2),
+        key = "busbw_GBs" if "busbw_GBs" in pt else "dispatch_GBs"
+        busbw = {key: round(pt[key], 2),
                  "at_bytes": pt["bytes"], "ranks": pt["ranks"]}
     except Exception as e:  # busbw is auxiliary; never sink the main metric
         print(f"busbw point failed: {e!r}", file=sys.stderr)
@@ -148,7 +156,7 @@ def worker(platform: str) -> None:
         "mfu": round(mfu, 4),
         "device_kind": device_kind,
         "n_devices": n_dev,
-        "allreduce_busbw": busbw,
+        "allreduce": busbw,
     }
     if backend == "tpu":
         # Persist the raw measurement so the anchor is backed by data.

@@ -59,9 +59,15 @@ def allreduce_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
     dt = (time.perf_counter() - t0) / iters
     payload = numel * itemsize
     algbw = payload / dt
-    busbw = algbw * (2 * (n - 1) / n) if n > 1 else algbw
-    return {"op": "allreduce", "bytes": payload, "time_us": dt * 1e6,
-            "algbw_GBs": algbw / 1e9, "busbw_GBs": busbw / 1e9, "ranks": n}
+    row = {"op": "allreduce", "bytes": payload, "time_us": dt * 1e6,
+           "algbw_GBs": algbw / 1e9, "ranks": n}
+    if n > 1:
+        row["busbw_GBs"] = algbw * (2 * (n - 1) / n) / 1e9
+    else:
+        # One rank has no wire: this is dispatch + HBM throughput, and it
+        # must not wear a bus-bandwidth label (round-3 verdict finding).
+        row["dispatch_GBs"] = algbw / 1e9
+    return row
 
 
 def sweep(sizes=None, **kw) -> list[dict]:
@@ -71,15 +77,28 @@ def sweep(sizes=None, **kw) -> list[dict]:
 
 
 def main() -> None:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu-devices", type=int, default=0, metavar="N",
+                    help="force an N-device virtual CPU rig (multi-rank "
+                    "busbw with real XLA collectives + protocol overhead; "
+                    "numbers are CPU-memory-bound, not ICI)")
+    args = ap.parse_args()
+    if args.cpu_devices:
+        from horovod_tpu.utils.cpurig import force_cpu_platform
+        force_cpu_platform(args.cpu_devices)
     import horovod_tpu as hvd
     hvd.init()
     rows = sweep()
     for r in rows:
         print(json.dumps(r))
-    best = max(rows, key=lambda r: r["busbw_GBs"])
-    print(json.dumps({"metric": "allreduce_busbw_peak", "value":
-                      round(best["busbw_GBs"], 2), "unit": "GB/s",
-                      "at_bytes": best["bytes"], "ranks": best["ranks"]}))
+    key = "busbw_GBs" if "busbw_GBs" in rows[0] else "dispatch_GBs"
+    best = max(rows, key=lambda r: r[key])
+    metric = ("allreduce_busbw_peak" if key == "busbw_GBs"
+              else "allreduce_dispatch_peak")
+    print(json.dumps({"metric": metric, "value": round(best[key], 2),
+                      "unit": "GB/s", "at_bytes": best["bytes"],
+                      "ranks": best["ranks"]}))
 
 
 if __name__ == "__main__":

@@ -19,10 +19,11 @@ Layout choices (see /opt/skills/guides/pallas_guide.md):
   inputs stay bf16.
 
 Measured on TPU v5 lite vs XLA's fused dense attention (bf16,
-B=4,H=16,D=64, causal), forward+backward — the training shape: 1.0x at
-S=512, 1.64x at 1024, 2.46x at 2048, 4.9x at 4096 (forward alone: 0.9x /
-1.51x / 1.95x / 6.92x).  Data committed in ``benchmarks/measured.jsonl``;
-reproduce with ``python benchmarks/flash_bench.py``.
+B=4,H=16,D=64, causal), forward+backward — the training shape, with the
+per-length block tuning in :func:`default_blocks` (round 4): 1.11x at
+S=512, 1.71x at 1024, 2.69x at 2048, 5.35x at 4096.  Data committed in
+``benchmarks/measured.jsonl``; reproduce with
+``python benchmarks/flash_bench.py``.
 """
 
 from __future__ import annotations
@@ -303,12 +304,22 @@ def _flash_backward(q, k, v, out, lse, g, *, scale, causal, block_q,
 # ---------------------------------------------------------------------------
 
 def default_blocks(seq_len: int) -> tuple[int, int]:
-    """Large query blocks amortize per-program cost; a fwd+bwd block
-    sweep on TPU v5 lite found bq=512/bk=512 fastest at every measured
-    sequence length (S=1024: 4.54 ms vs 4.94 with the old bk=1024;
-    S=4096: 14.3 vs 15.2 — the ``flash_block_sweep`` record in
-    benchmarks/measured.jsonl)."""
-    b = next((c for c in (512, 256, 128) if seq_len % c == 0), 128)
+    """Per-length (bq, bk) from the round-4 fwd+bwd sweep on TPU v5 lite
+    over the full bq×bk grid (the ``flash_block_sweep_r4`` record in
+    benchmarks/measured.jsonl; B=4 H=16 D=64 bf16 causal, vs XLA dense):
+
+        S=512:  (512, 256) → 1.87 ms, 1.11x   (512² ran 0.39x — the old
+        S=1024: (256, 512) → 2.58 ms, 1.71x    one-size default lost at
+        S=2048: (512, 512) → 4.84 ms, 2.69x    short S)
+        S=4096: (512, 512) → 12.3 ms, 5.35x
+    """
+    if seq_len == 512:
+        return 512, 256
+    if seq_len == 1024:
+        return 256, 512
+    if seq_len % 512 == 0:
+        return 512, 512
+    b = next((c for c in (256, 128) if seq_len % c == 0), 128)
     return b, b  # two-tuple API: callers may still override bq/bk apart
 
 
