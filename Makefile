@@ -1,0 +1,29 @@
+# Repo-level targets.  `make ci` runs the committed CI matrix (ci.yaml)
+# locally — the supported-config list, in the role of the reference's
+# † .buildkite/gen-pipeline.sh generated matrix.
+
+PY ?= python
+
+.PHONY: ci native test mp-test examples bench
+
+ci: native
+	$(PY) -c "import horovod_tpu, horovod_tpu.torch, horovod_tpu.tensorflow, \
+horovod_tpu.keras, horovod_tpu.elastic, horovod_tpu.spark, horovod_tpu.ray"
+	$(PY) -m pytest tests -q -x --ignore=tests/test_runner.py
+	$(PY) -m pytest tests/test_runner.py -q -x
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+native:
+	$(MAKE) -C native
+
+test:
+	$(PY) -m pytest tests -q
+
+mp-test:
+	$(PY) -m pytest tests/test_runner.py -q
+
+examples:
+	$(PY) -m pytest tests/test_examples.py -q
+
+bench:
+	$(PY) bench.py

@@ -619,7 +619,15 @@ def gloo_enabled() -> bool:
 def is_homogeneous() -> bool:
     """True when every process drives the same number of devices
     († ``horovod_is_homogeneous``: equal local sizes on all hosts —
-    heterogeneous jobs disable some fusion fast paths upstream)."""
+    heterogeneous jobs disable some fusion fast paths upstream).
+
+    Single-controller approximation: derived as ``size == local_size *
+    cross_size`` from THIS process's view rather than comparing every
+    rank's local size over the control plane (the reference gathers all
+    local sizes).  A heterogeneous job whose local sizes happen to
+    multiply out (e.g. 1,2,3 seen from a 2-slot host) reports True; the
+    launcher's slot assignment produces equal slots per host, so this
+    arises only with hand-built rank maps."""
     from .context import cross_size, local_size, size
     return size() == local_size() * cross_size()
 

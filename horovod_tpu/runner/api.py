@@ -21,10 +21,15 @@ functions work, † cloudpickle payloads in ``runner/common/util/codec.py``).
   ------                                  ------
   put payload blob in KV                  fetch payload blob
   launch workers (launch_workers)         result = func(*args, **kwargs)
-  collector thread waits on               put result blob in KV
-    runfunc/result/<rank> for all ranks   wait for runfunc/ack (so the
-  set runfunc/ack                           driver's KV server outlives
-  join collector; unpickle; return          the read), then exit
+  collector thread reads                  put result blob in KV
+    runfunc/result/<rank> as each         wait for runfunc/ack/<rank> (so
+    lands and sets runfunc/ack/<rank>       the driver's KV server outlives
+    immediately                             the read), then exit
+  join collector; unpickle; return
+
+  Acks are PER RANK so a worker exits the moment its own result is read —
+  a peer hanging in a collective must not hold an already-finished (or
+  already-failed) worker for the full ack timeout.
 
 Values larger than the control-plane frame limit are chunked
 (:func:`kv_put_blob`).  A worker whose function raises reports the
@@ -44,7 +49,7 @@ _CHUNK = 4 << 20
 
 _PAYLOAD_KEY = "runfunc/payload"
 _RESULT_KEY = "runfunc/result/{rank}"
-_ACK_KEY = "runfunc/ack"
+_ACK_KEY = "runfunc/ack/{rank}"
 
 
 def kv_put_blob(kv, prefix: str, data: bytes) -> None:
@@ -66,12 +71,13 @@ def kv_get_blob(kv, prefix: str, timeout_ms: int = 10000) -> bytes:
 
 
 def _collect(kv, np_total: int, results: dict, stop: threading.Event) -> None:
-    """Driver-side collector: read every rank's result blob as it lands,
-    then publish the ack that releases the workers to exit.
+    """Driver-side collector: read every rank's result blob as it lands and
+    immediately publish that rank's ack, releasing the worker to exit.
 
     Sweeps ALL outstanding ranks non-blockingly each pass — a rank that
     hangs (e.g. blocked in a collective on a crashed peer) must not hide
-    a later rank's already-published failure traceback."""
+    a later rank's already-published failure traceback, nor delay another
+    worker's exit."""
     outstanding = set(range(np_total))
     while outstanding and not stop.is_set():
         progressed = False
@@ -81,6 +87,7 @@ def _collect(kv, np_total: int, results: dict, stop: threading.Event) -> None:
                 if kv.get(f"{key}/meta") is None:
                     continue
                 results[rank] = kv_get_blob(kv, key, timeout_ms=1000)
+                kv.set(_ACK_KEY.format(rank=rank), b"1")
             except TimeoutError:
                 continue
             except (ConnectionError, OSError):
@@ -89,11 +96,6 @@ def _collect(kv, np_total: int, results: dict, stop: threading.Event) -> None:
             progressed = True
         if outstanding and not progressed:
             stop.wait(0.05)
-    if not outstanding:
-        try:
-            kv.set(_ACK_KEY, b"1")
-        except (ConnectionError, OSError):
-            pass
 
 
 def _pickle_module_by_value(mod) -> bool:
@@ -170,9 +172,14 @@ def run_func(func, args: Sequence[Any] = (), kwargs: Optional[dict] = None,
                               verbose=verbose, services_hook=services_hook)
     finally:
         stop.set()
-        if "thread" in state:
-            state["thread"].join(timeout=5)
-        if "kv" in state:
+        thread = state.get("thread")
+        if thread is not None:
+            thread.join(timeout=5)
+        if "kv" in state and (thread is None or not thread.is_alive()):
+            # Close only once the collector has provably exited: closing
+            # under a live collector nulls the native handle mid-call.  A
+            # still-alive daemon thread keeps (and leaks) the client; the
+            # missing-results check below reports the incomplete snapshot.
             try:
                 state["kv"].close()
             except OSError:
@@ -227,9 +234,13 @@ def worker_main() -> int:
         code = 1
     kv_put_blob(kv, _RESULT_KEY.format(rank=rank), out)
     try:
-        # Hold until the driver has read the results (its KV server dies
-        # with the job) — bounded so a dead driver never wedges a worker.
-        kv.wait(_ACK_KEY, timeout_ms=60000)
+        # Hold until the driver has read THIS rank's result (its KV server
+        # dies with the job) — the driver acks per rank as soon as it
+        # collects, so a hung peer never delays this worker's exit.  A
+        # failed worker waits a shorter bound: its exit is what triggers
+        # the launcher's teardown, so surfacing the error beats lingering.
+        timeout_ms = 60000 if code == 0 else 10000
+        kv.wait(_ACK_KEY.format(rank=rank), timeout_ms=timeout_ms)
     except (TimeoutError, ConnectionError, OSError):
         pass
     kv.close()

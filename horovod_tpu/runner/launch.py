@@ -289,11 +289,17 @@ def launch_workers(command: Sequence[str], *, np_total: int,
                 # `ssh host env K=V ... cmd` line) — EXCEPT the job secret,
                 # which would be world-readable in /proc/<pid>/cmdline on
                 # the remote host; it travels over ssh stdin instead.
+                # Forward the control-plane block, interpreter paths, AND
+                # every caller-supplied extra_env key — the remote shell
+                # starts from a fresh ssh environment, so anything not on
+                # this line is silently dropped for remote ranks.
+                forwarded = set(extra_env or ())
                 env_kv = " ".join(
                     f"{k}={shlex.quote(v)}" for k, v in env.items()
                     if k != "HVDTPU_SECRET"
-                    and k.startswith(("HVDTPU_", "HOROVOD_", "PATH",
-                                      "PYTHONPATH")))
+                    and (k in forwarded
+                         or k.startswith(("HVDTPU_", "HOROVOD_", "PATH",
+                                          "PYTHONPATH"))))
                 remote = ("IFS= read -r HVDTPU_SECRET && "
                           "export HVDTPU_SECRET && "
                           f"cd {shlex.quote(os.getcwd())} && env {env_kv} "
