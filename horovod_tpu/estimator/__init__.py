@@ -27,11 +27,13 @@ from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
-from .store import LocalStore, to_columns, train_val_split
+from .store import (FilesystemStore, LocalStore, ParquetBatches, Store,
+                    to_columns, train_val_split)
 
 __all__ = [
     "JaxEstimator", "JaxModel", "KerasEstimator", "KerasModel",
-    "LocalStore", "to_columns",
+    "Store", "FilesystemStore", "LocalStore", "ParquetBatches",
+    "to_columns",
 ]
 
 
@@ -172,6 +174,9 @@ class JaxEstimator:
         import optax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from .store import ParquetBatches
+        if isinstance(data, ParquetBatches):
+            return self._fit_streaming(data)
         cols = to_columns(data,
                           columns=self.feature_cols + self.label_cols)
         val_cols = None
@@ -183,33 +188,10 @@ class JaxEstimator:
         labels = _labels_array(cols, self.label_cols)
         n = len(feats)
 
-        mesh = self._mesh()
-        data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
-        n_data = int(np.prod([mesh.shape[a] for a in data_axes])) or 1
-        batch = max(self.batch_size // n_data, 1) * n_data
-        if n < batch:
-            raise ValueError(
-                f"{n} rows < one global batch ({batch}); lower batch_size")
-        batch_shard = NamedSharding(mesh, P(data_axes))
-        repl = NamedSharding(mesh, P())
-
-        tx = self.optimizer or optax.adam(1e-3)
-        rng = jax.random.PRNGKey(self.seed)
-        params = jax.jit(
-            lambda r: self.model.init(r, jnp.asarray(feats[:1])),
-            out_shardings=repl)(rng)
-        opt_state = jax.jit(tx.init)(params)
-
-        def loss_of(p, f, y):
-            return self.loss(self.model.apply(p, f), y)
-
-        @jax.jit
-        def train_step(p, s, f, y):
-            lval, grads = jax.value_and_grad(loss_of)(p, f, y)
-            updates, s = tx.update(grads, s, p)
-            return optax.apply_updates(p, updates), s, lval
-
-        eval_step = jax.jit(loss_of)
+        ts = self._train_setup(feats[:1], n)
+        params, opt_state = ts["params"], ts["opt_state"]
+        train_step, eval_step = ts["train_step"], ts["eval_step"]
+        batch, batch_shard = ts["batch"], ts["batch_shard"]
 
         history = []
         shuffle_rng = np.random.RandomState(self.seed)
@@ -233,12 +215,112 @@ class JaxEstimator:
                 vy = jnp.asarray(_labels_array(val_cols, self.label_cols))
                 entry["val_loss"] = float(eval_step(params, vf, vy))
             history.append(entry)
-            if self.verbose:
-                print(f"[JaxEstimator] {entry}")
-            if self.store is not None:
-                from ..utils.checkpoint import Checkpointer
-                Checkpointer(self.store.checkpoint_path(self.run_id)) \
-                    .save(epoch, {"params": params})
+            self._epoch_end(entry, epoch, params)
+
+        return JaxModel(module=self.model, params=params,
+                        feature_cols=self.feature_cols,
+                        label_cols=self.label_cols, history=history)
+
+    def _train_setup(self, feats0, n_rows: int) -> dict:
+        """Shared mesh/batch/sharding/init/step setup for both fit paths
+        (one source of truth — the streaming path must never drift from
+        the in-memory path on batch rounding, sharding, or step math)."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._mesh()
+        data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
+        n_data = int(np.prod([mesh.shape[a] for a in data_axes])) or 1
+        batch = max(self.batch_size // n_data, 1) * n_data
+        if n_rows < batch:
+            raise ValueError(
+                f"{n_rows} rows < one global batch ({batch}); "
+                "lower batch_size")
+        batch_shard = NamedSharding(mesh, P(data_axes))
+        repl = NamedSharding(mesh, P())
+
+        tx = self.optimizer or optax.adam(1e-3)
+        rng = jax.random.PRNGKey(self.seed)
+        params = jax.jit(
+            lambda r: self.model.init(r, jnp.asarray(feats0)),
+            out_shardings=repl)(rng)
+        opt_state = jax.jit(tx.init)(params)
+
+        def loss_of(p, f, y):
+            return self.loss(self.model.apply(p, f), y)
+
+        @jax.jit
+        def train_step(p, s, f, y):
+            lval, grads = jax.value_and_grad(loss_of)(p, f, y)
+            updates, s = tx.update(grads, s, p)
+            return optax.apply_updates(p, updates), s, lval
+
+        return {"batch": batch, "batch_shard": batch_shard, "repl": repl,
+                "params": params, "opt_state": opt_state,
+                "train_step": train_step, "eval_step": jax.jit(loss_of)}
+
+    def _epoch_end(self, entry: dict, epoch: int, params) -> None:
+        if self.verbose:
+            print(f"[JaxEstimator] {entry}")
+        if self.store is not None:
+            from ..utils.checkpoint import Checkpointer
+            Checkpointer(self.store.checkpoint_path(self.run_id)) \
+                .save(epoch, {"params": params})
+
+    def _fit_streaming(self, batches) -> JaxModel:
+        """Fit from a :class:`~horovod_tpu.estimator.store.ParquetBatches`
+        source: row-group chunks stream through host RAM one at a time
+        (peak memory = one chunk + one global batch), so the dataset can
+        be arbitrarily larger than memory — the Petastorm role
+        († ``horovod.spark`` estimators train from materialized parquet,
+        never a driver collect).  Shuffling is within-chunk (plus the
+        chunk remainder carried forward); validation needs a separate
+        materialized split."""
+        import jax
+
+        if self.validation:
+            raise ValueError(
+                "streaming fit has no row-level validation split — "
+                "materialize a validation parquet and evaluate it with "
+                "model.predict")
+        # One-row peek for init shapes (no full-chunk decode).
+        feats0 = _features_matrix(batches.first_rows(1), self.feature_cols)
+        ts = self._train_setup(feats0, len(batches))
+        params, opt_state = ts["params"], ts["opt_state"]
+        train_step = ts["train_step"]
+        batch, batch_shard = ts["batch"], ts["batch_shard"]
+
+        history = []
+        shuffle_rng = np.random.RandomState(self.seed)
+        for epoch in range(self.epochs):
+            epoch_loss, steps = 0.0, 0
+            rem_f = rem_y = None
+            for chunk in batches:
+                f = _features_matrix(chunk, self.feature_cols)
+                y = _labels_array(chunk, self.label_cols)
+                if self.shuffle:
+                    order = shuffle_rng.permutation(len(f))
+                    f, y = f[order], y[order]
+                if rem_f is not None and len(rem_f):
+                    f = np.concatenate([rem_f, f])
+                    y = np.concatenate([rem_y, y])
+                n_full = (len(f) // batch) * batch
+                for i in range(0, n_full, batch):
+                    fb = jax.device_put(f[i:i + batch], batch_shard)
+                    yb = jax.device_put(y[i:i + batch], batch_shard)
+                    params, opt_state, lval = train_step(
+                        params, opt_state, fb, yb)
+                    epoch_loss += float(lval)
+                    steps += 1
+                rem_f, rem_y = f[n_full:], y[n_full:]
+            # The final sub-batch remainder is dropped (drop_last
+            # semantics; static shapes keep the step compiled once).
+            entry = {"epoch": epoch, "loss": epoch_loss / max(steps, 1),
+                     "steps": steps}
+            history.append(entry)
+            self._epoch_end(entry, epoch, params)
 
         return JaxModel(module=self.model, params=params,
                         feature_cols=self.feature_cols,

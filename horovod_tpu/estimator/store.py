@@ -19,15 +19,34 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 
-class LocalStore:
-    """Run-artifact store rooted at a local (or NFS/GCS-fuse) directory.
+class Store:
+    """Run-artifact store interface († ``horovod/spark/common/store.py``:
+    the reference ships LocalStore/HDFSStore/S3Store behind one surface).
 
-    Layout: ``<prefix>/runs/<run_id>/checkpoints`` and ``.../logs`` —
-    mirroring † ``Store.get_checkpoint_path`` / ``get_logs_path``.
+    Layout contract: ``<prefix>/runs/<run_id>/checkpoints`` and
+    ``.../logs`` — mirroring † ``Store.get_checkpoint_path`` /
+    ``get_logs_path``.  Use :meth:`create` to pick a flavor from a path.
     """
 
-    def __init__(self, prefix: str) -> None:
-        self.prefix = os.path.abspath(prefix)
+    prefix: str
+
+    @staticmethod
+    def create(prefix: str) -> "Store":
+        """Store for ``prefix``.  Filesystem paths (including NFS and
+        FUSE-mounted buckets) get :class:`FilesystemStore`; bare
+        ``gs://``/``s3://``/``hdfs://`` URLs are rejected with the mount
+        instruction — on TPU VMs object stores are reached through
+        gcsfuse/s3fs mounts so every consumer (orbax, logs, pyarrow) sees
+        one POSIX surface, rather than through per-scheme client code
+        († upstream's HDFSStore/S3Store role)."""
+        scheme = prefix.split("://", 1)[0] if "://" in prefix else ""
+        if scheme in ("gs", "s3", "hdfs", "abfs"):
+            raise ValueError(
+                f"{prefix!r}: mount the bucket (gcsfuse/s3fs/...) and pass "
+                "the mount path — object stores are consumed through "
+                "FUSE mounts here, one POSIX surface for checkpoints, "
+                "logs, and parquet alike")
+        return FilesystemStore(prefix)
 
     def run_path(self, run_id: str) -> str:
         return os.path.join(self.prefix, "runs", run_id)
@@ -41,6 +60,94 @@ class LocalStore:
         path = os.path.join(self.run_path(run_id), "logs")
         os.makedirs(path, exist_ok=True)
         return path
+
+
+class FilesystemStore(Store):
+    """Store on any mounted filesystem path: local disk, NFS, or a
+    FUSE-mounted object store (gcsfuse/s3fs)."""
+
+    def __init__(self, prefix: str) -> None:
+        self.prefix = os.path.abspath(prefix)
+
+
+class LocalStore(FilesystemStore):
+    """Back-compat name for :class:`FilesystemStore` rooted locally."""
+
+
+class ParquetBatches:
+    """Streaming parquet reader: iterate row-group-sized column batches
+    without ever materializing the dataset (the Petastorm role for data
+    larger than RAM; † ``horovod.spark``'s estimators stream training data
+    from materialized parquet rather than collecting it to the driver).
+
+    Iterating yields ``{column: np.ndarray}`` chunks of ``<= batch_rows``
+    rows; peak memory is one chunk, not the dataset.
+    """
+
+    def __init__(self, path: str,
+                 columns: Optional[Sequence[str]] = None,
+                 batch_rows: int = 16384) -> None:
+        import pyarrow.parquet as pq
+        self.path = path
+        self.columns = list(columns) if columns is not None else None
+        self.batch_rows = int(batch_rows)
+        self.files = (sorted(glob.glob(os.path.join(path, "*.parquet")))
+                      if os.path.isdir(path) else [path])
+        if not self.files:
+            raise FileNotFoundError(f"no parquet files under {path}")
+        self.num_rows = 0
+        for f in self.files:
+            pf = pq.ParquetFile(f)
+            self.num_rows += pf.metadata.num_rows
+            # Validate EVERY file upfront: a later part missing a column
+            # must not surface as an opaque pyarrow error mid-epoch.
+            if self.columns is not None:
+                names = set(pf.schema_arrow.names)
+                missing = [c for c in self.columns if c not in names]
+                if missing:
+                    raise KeyError(f"columns {missing} not in parquet "
+                                   f"file {f} (have {sorted(names)})")
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    def first_rows(self, n: int = 1) -> dict[str, np.ndarray]:
+        """The first ``n`` rows only (shape/dtype peek for model init)
+        without decoding a full chunk to numpy."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        pf = pq.ParquetFile(self.files[0])
+        rb = next(pf.iter_batches(batch_size=n, columns=self.columns))
+        table = pa.Table.from_batches([rb])
+        out = {}
+        for name in table.column_names:
+            col = table.column(name).combine_chunks()
+            if (pa.types.is_list(col.type)
+                    or pa.types.is_fixed_size_list(col.type)):
+                flat = col.flatten().to_numpy(zero_copy_only=False)
+                out[name] = flat.reshape(len(col), -1)
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
+
+    def __iter__(self):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        for f in self.files:
+            pf = pq.ParquetFile(f)
+            for rb in pf.iter_batches(batch_size=self.batch_rows,
+                                      columns=self.columns):
+                table = pa.Table.from_batches([rb])
+                out = {}
+                for name in table.column_names:
+                    col = table.column(name).combine_chunks()
+                    if (pa.types.is_list(col.type)
+                            or pa.types.is_fixed_size_list(col.type)):
+                        flat = col.flatten().to_numpy(zero_copy_only=False)
+                        out[name] = flat.reshape(len(col), -1)
+                    else:
+                        out[name] = col.to_numpy(zero_copy_only=False)
+                yield out
 
 
 def _read_parquet(path: str,

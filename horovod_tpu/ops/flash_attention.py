@@ -19,10 +19,10 @@ Layout choices (see /opt/skills/guides/pallas_guide.md):
   inputs stay bf16.
 
 Measured on TPU v5 lite vs XLA's fused dense attention (bf16,
-B=4,H=16,D=64, causal), forward+backward — the training shape, with the
-per-length block tuning in :func:`default_blocks` (round 4): 1.11x at
-S=512, 1.71x at 1024, 2.69x at 2048, 5.35x at 4096.  Data committed in
-``benchmarks/measured.jsonl``; reproduce with
+B=4,H=16,D=64, causal), forward+backward — the training shape, with
+bf16-MXU dots and the per-length block tuning in :func:`default_blocks`
+(round 4): 1.01x at S=512, 1.82x at 1024, 2.54x at 2048, 5.28x at 4096.
+Data committed in ``benchmarks/measured.jsonl``; reproduce with
 ``python benchmarks/flash_bench.py``.
 """
 
@@ -68,15 +68,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    # Dots take the INPUT dtype with fp32 MXU accumulation: casting bf16
+    # operands to fp32 before the matmul forces fp32-rate MXU passes
+    # (~2-4x slower on v5e); the canonical flash formulation keeps q/k/v
+    # bf16 and scales the fp32 score block instead.
+    q = q_ref[0]                                      # [BQ, D]
     n_kv = seq_len // block_k
 
     def body(ki, carry):
         m, l, acc = carry
         k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :]
         v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :]
-        s = jnp.dot(q, k_blk.astype(jnp.float32).T,
-                    preferred_element_type=jnp.float32)
+        s = jnp.dot(q, k_blk.T,
+                    preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -88,7 +92,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[:, None])
         l_new = l * alpha + jnp.sum(p, axis=-1)
-        pv = jnp.dot(p, v_blk.astype(jnp.float32),
+        pv = jnp.dot(p.astype(v_blk.dtype), v_blk,
                      preferred_element_type=jnp.float32)
         return m_new, l_new, acc * alpha[:, None] + pv
 
@@ -155,15 +159,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)                  # [BQ, D]
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]                                      # [BQ, D] input dtype
+    do = do_ref[0]
     lse = lse_ref[0, 0]                               # [BQ]
     delta = delta_ref[0, 0]                           # [BQ]
     n_kv = seq_len // block_k
 
     def body(ki, dq):
-        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        # bf16 operands on the MXU, fp32 accumulation (see _fwd_kernel).
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -171,10 +176,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])                  # [BQ, BK]
+        p = jnp.exp(s - lse[:, None])                  # [BQ, BK] fp32
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+        return dq + jnp.dot(ds.astype(k_blk.dtype), k_blk,
+                            preferred_element_type=jnp.float32)
 
     if causal:
         upper = jax.lax.min(
@@ -192,14 +198,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                  # [BK, D]
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]                                      # [BK, D] input dtype
+    v = v_ref[0]
     n_q = seq_len // block_q
 
     def body(qi, carry):
         dk, dv = carry
-        q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        # bf16 operands on the MXU, fp32 accumulation (see _fwd_kernel).
+        q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :]
         lse_blk = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
         delta_blk = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
         s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
@@ -209,12 +216,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse_blk[:, None])              # [BQ, BK]
-        dv_new = dv + jnp.dot(p.T, do_blk,
+        p = jnp.exp(s - lse_blk[:, None])              # [BQ, BK] fp32
+        dv_new = dv + jnp.dot(p.astype(do_blk.dtype).T, do_blk,
                               preferred_element_type=jnp.float32)
         dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta_blk[:, None]) * scale
-        dk_new = dk + jnp.dot(ds.T, q_blk,
+        dk_new = dk + jnp.dot(ds.astype(q_blk.dtype).T, q_blk,
                               preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
@@ -304,19 +311,20 @@ def _flash_backward(q, k, v, out, lse, g, *, scale, causal, block_q,
 # ---------------------------------------------------------------------------
 
 def default_blocks(seq_len: int) -> tuple[int, int]:
-    """Per-length (bq, bk) from the round-4 fwd+bwd sweep on TPU v5 lite
-    over the full bq×bk grid (the ``flash_block_sweep_r4`` record in
-    benchmarks/measured.jsonl; B=4 H=16 D=64 bf16 causal, vs XLA dense):
+    """Per-length (bq, bk) from the round-4 fwd+bwd sweeps on TPU v5 lite
+    over the full bq×bk grid (``flash_block_sweep_r4`` records in
+    benchmarks/measured.jsonl; B=4 H=16 D=64 bf16 causal, vs XLA dense).
+    Measured AFTER the bf16-MXU kernel fix (operands stay bf16, fp32
+    accumulation — the fp32-cast version ran the matmuls at fp32 MXU
+    rate and its optimum differed):
 
-        S=512:  (512, 256) → 1.87 ms, 1.11x   (512² ran 0.39x — the old
-        S=1024: (256, 512) → 2.58 ms, 1.71x    one-size default lost at
-        S=2048: (512, 512) → 4.84 ms, 2.69x    short S)
-        S=4096: (512, 512) → 12.3 ms, 5.35x
+        S=512:  (512, 256) → 1.89 ms, 1.01x
+        S=1024: (512, 512) → 2.42 ms, 1.82x
+        S=2048: (512, 512) → 4.79 ms, 2.54x
+        S=4096: (512, 512) → 12.4 ms, 5.28x
     """
     if seq_len == 512:
         return 512, 256
-    if seq_len == 1024:
-        return 256, 512
     if seq_len % 512 == 0:
         return 512, 512
     b = next((c for c in (256, 128) if seq_len % c == 0), 128)

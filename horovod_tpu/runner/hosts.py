@@ -59,3 +59,25 @@ def assign_ranks(hosts: List[HostSlots], np_total: int
             out.append((rank, h.hostname, local))
             rank += 1
     return out
+
+
+def host_hash(salt: str = "") -> str:
+    """Stable identifier for THIS machine, for grouping ranks that share a
+    host († ``runner/common/util/host_hash.py``: upstream hashes the
+    hostname so ranks on one box agree on local-rank grouping even when
+    launched under different names).
+
+    ``HOROVOD_HOSTNAME`` overrides the detected hostname — the upstream
+    escape hatch for containers where every worker reports the same
+    hostname (or conversely where one machine answers to many).  ``salt``
+    perturbs the hash the way upstream's ``--mpi-args`` salt does, for
+    deliberately splitting co-located workers into separate groups.
+    """
+    import hashlib
+    import os
+    import socket
+
+    # Native prefix wins over the compat prefix, as everywhere in config.
+    name = os.environ.get("HVDTPU_HOSTNAME") or os.environ.get(
+        "HOROVOD_HOSTNAME") or socket.gethostname()
+    return hashlib.md5(f"{name}-{salt}".encode()).hexdigest()

@@ -26,13 +26,45 @@ class Checkpointer:
         restored = ckpt.restore(target={"params": params_like, ...})
     """
 
-    def __init__(self, directory: str, *, max_to_keep: int = 3) -> None:
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 single_process: bool = False,
+                 read_only: bool = False) -> None:
+        """``single_process=True`` scopes orbax's cross-process barriers to
+        THIS process.  Required when saving from one rank of a
+        ``jax.distributed``-initialized multi-process job (the hvdrun
+        rig): rank-0-only saves otherwise deadlock in the multihost sync
+        that expects every process to participate.  Non-saving ranks of
+        such a job should ALSO pass ``read_only=True`` — a writable
+        manager's constructor sweeps ``*-tmp`` directories, racing the
+        primary's in-flight save."""
         import orbax.checkpoint as ocp
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
+        if single_process:
+            import jax as _jax
+            me = _jax.process_index()
+            mp_options = ocp.options.MultiprocessingOptions(
+                primary_host=me, active_processes={me},
+                barrier_sync_key_prefix=f"proc{me}")
+        else:
+            mp_options = ocp.options.MultiprocessingOptions()
         self._mgr = ocp.CheckpointManager(
             self._dir,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                read_only=read_only,
+                # Synchronous saves in single-process mode: the async
+                # path's tmp->final rename lands after wait_until_finished
+                # under scoped active_processes, so a peer reading "the
+                # latest step" right after a cross-process barrier could
+                # still see the unfinalized tmp directory.
+                enable_async_checkpointing=not single_process,
+                # A reader must never sweep the writer's tmp directories.
+                cleanup_tmp_directories=not read_only,
+                # The directory is created above; orbax refuses
+                # create=True alongside active_processes.
+                create=not single_process and not read_only,
+                multiprocessing_options=mp_options))
 
     def save(self, step: int, tree: Any, *, wait: bool = True) -> None:
         import orbax.checkpoint as ocp

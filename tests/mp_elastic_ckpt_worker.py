@@ -1,0 +1,140 @@
+"""Worker for the elastic x checkpoint end-to-end circle (VERDICT r3 #5).
+
+Trains a real jax model (tiny MLP, adam) under ``@hvd.elastic.run``; every
+committed step ALSO writes a sharded orbax checkpoint
+(:class:`horovod_tpu.utils.checkpoint.Checkpointer`) of params + optimizer
+moments + step, and every (re)start restores from the latest checkpoint —
+the durable-restore leg the in-memory elastic ``State`` cannot provide
+(† SURVEY §5.3-5.4: the reference's elastic state is host-RAM only).
+
+The training is FULL-batch (identical fixed data on every rank), so the
+averaged gradient — and therefore the whole loss trajectory — is
+world-size-invariant: after any kill/grow world-size change, the restored
+run must produce EXACTLY the losses an uninterrupted run would have.  The
+test asserts that merged (step -> loss) records from all incarnations
+agree, which only holds if params AND adam moments round-trip through
+orbax across np=4 -> np=2 -> np=4.
+
+Env knobs: HVDTPU_TEST_STATE/LOG/CKPT, HVDTPU_TEST_KILL (rank 2 crashes at
+step 4 in the first np=4 incarnation), HVDTPU_TEST_TOTAL,
+HVDTPU_TEST_STEP_DELAY.
+"""
+
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=1"
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.elastic as hvd_elastic  # noqa: E402
+from horovod_tpu.elastic import FileBackedState  # noqa: E402
+from horovod_tpu.utils.checkpoint import Checkpointer  # noqa: E402
+
+KILL_STEP = 4
+
+
+def log_line(path: str, text: str) -> None:
+    with open(path, "a") as f:
+        f.write(text + "\n")
+
+
+def build():
+    rng = np.random.RandomState(7)
+    X = jnp.asarray(rng.randn(32, 4), jnp.float32)
+    y = jnp.asarray(rng.randn(32, 1), jnp.float32)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    params = {"w1": jax.random.normal(k1, (4, 8)) * 0.5,
+              "b1": jnp.zeros((8,)),
+              "w2": jax.random.normal(k2, (8, 1)) * 0.5,
+              "b2": jnp.zeros((1,))}
+
+    def loss_fn(p):
+        h = jnp.tanh(X @ p["w1"] + p["b1"])
+        pred = h @ p["w2"] + p["b2"]
+        return jnp.mean((pred - y) ** 2)
+
+    return params, loss_fn
+
+
+def main() -> int:
+    log_path = os.environ["HVDTPU_TEST_LOG"]
+    ckpt_dir = os.environ["HVDTPU_TEST_CKPT"]
+    total = int(os.environ.get("HVDTPU_TEST_TOTAL", "12"))
+    delay = float(os.environ.get("HVDTPU_TEST_STEP_DELAY", "0"))
+    kill = os.environ.get("HVDTPU_TEST_KILL") == "1"
+    hvd.init()
+    me, n = hvd.rank(), hvd.size()
+
+    params, loss_fn = build()
+    tx = optax.adam(5e-2)
+    opt_state = tx.init(params)
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    ckpt = Checkpointer(ckpt_dir, max_to_keep=2, single_process=True,
+                        read_only=me != 0)
+    # orbax in a jax.distributed job refuses host-local jax.Arrays, so the
+    # tree crosses the checkpoint boundary as numpy (jit re-devices it).
+    as_np = lambda tree: jax.tree.map(np.asarray, tree)
+    start_step = 0
+    latest = ckpt.latest_step()
+    if latest is not None:
+        restored = ckpt.restore(latest, target=as_np(
+            {"params": params, "opt_state": opt_state, "step": 0}))
+        params, opt_state = restored["params"], restored["opt_state"]
+        start_step = int(restored["step"])
+    # Elastic bookkeeping state (epoch checks / restart codes live here).
+    state = FileBackedState(os.environ["HVDTPU_TEST_STATE"],
+                            step=start_step)
+    state.step = max(state.step, start_step)
+    log_line(log_path, f"START rank={me} size={n} resume_step={state.step}")
+
+    @hvd_elastic.run
+    def train(state):
+        nonlocal params, opt_state
+        for step in range(state.step, total):
+            if (kill and n == 4 and me == 2 and step == KILL_STEP
+                    and start_step == 0):
+                log_line(log_path, f"CRASH rank={me} step={step}")
+                os._exit(7)
+            if delay:
+                time.sleep(delay)
+            loss, grads = grad_fn(params)
+            # Engine-negotiated gradient averaging (full-batch data ->
+            # averaging is a no-op numerically, any world size).
+            flat, tree = jax.tree.flatten(grads)
+            outs = hvd.grouped_allreduce(
+                [hvd.from_local(np.asarray(g)[None]) for g in flat],
+                hvd.Average)
+            # to_numpy returns this rank's payload with the leading
+            # per-rank dim already stripped.
+            grads = jax.tree.unflatten(
+                tree, [jnp.asarray(hvd.to_numpy(o)) for o in outs])
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            if me == 0:
+                ckpt.save(step + 1, as_np({"params": params,
+                                           "opt_state": opt_state,
+                                           "step": step + 1}))
+            state.step = step + 1
+            state.commit()
+            log_line(log_path,
+                     f"STEP rank={me} size={n} step={step} "
+                     f"loss={float(loss):.8f}")
+        return params
+
+    train(state)
+    hvd.shutdown()
+    log_line(log_path, f"DONE rank={me} size={n} step={state.step}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

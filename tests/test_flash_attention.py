@@ -53,7 +53,10 @@ def test_flash_gradients_match_dense():
 
 
 def test_default_blocks_divisibility():
+    # Per-length tuning from the round-4 fwd+bwd sweep (see module doc).
+    assert default_blocks(512) == (512, 256)
     assert default_blocks(1024) == (512, 512)
+    assert default_blocks(2048) == (512, 512)
     assert default_blocks(256) == (256, 256)
     assert default_blocks(384) == (128, 128)
 

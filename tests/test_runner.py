@@ -310,3 +310,118 @@ def test_hvdrun_missing_np():
         capture_output=True, text=True, timeout=60, cwd=REPO)
     assert res.returncode == 2
     assert "num-proc" in res.stderr
+
+
+@pytest.mark.integration
+def test_hvdrun_elastic_checkpoint_world_size_circle(tmp_path):
+    """Elastic x orbax checkpoint across WORLD SIZES (VERDICT r3 #5): train
+    at np=4, rank 2 crashes (its 2-slot host is blacklisted -> np=2), the
+    relaunch restores params+adam moments+step from orbax; mid-run the
+    discovery file gains a third host -> grow circle back to np=4 with
+    another restore.  The worker trains full-batch (gradient averaging is
+    world-size-invariant), so EVERY logged loss must match the
+    uninterrupted single-process oracle — which only holds if the model
+    and optimizer state round-trip exactly through every restart."""
+    from horovod_tpu.runner.cluster import local_ip
+    my_ip = local_ip()  # the launcher's own notion of "this machine"
+    assert my_ip not in ("localhost", "127.0.0.1"), my_ip
+    hostsfile = tmp_path / "hosts.txt"
+    hostsfile.write_text("localhost:2\n127.0.0.1:2\n")
+    discover = tmp_path / "discover.sh"
+    discover.write_text(f"#!/bin/sh\ncat {hostsfile}\n")
+    discover.chmod(0o755)
+    state = tmp_path / "state.json"
+    log = tmp_path / "train.log"
+    ckpt = tmp_path / "ckpts"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({"HVDTPU_TEST_STATE": str(state), "HVDTPU_TEST_LOG": str(log),
+                "HVDTPU_TEST_CKPT": str(ckpt), "HVDTPU_TEST_KILL": "1",
+                "HVDTPU_TEST_TOTAL": "24", "HVDTPU_TEST_STEP_DELAY": "0.3"})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "4",
+         "--min-np", "2", "--max-np", "4",
+         "--host-discovery-script", str(discover), "--",
+         sys.executable,
+         os.path.join(REPO, "tests", "mp_elastic_ckpt_worker.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+    try:
+        # After the shrink incarnation (np=2) commits a few steps, offer a
+        # fresh host so the growth watcher fires.
+        deadline = time.time() + 180
+        grown = False
+        while time.time() < deadline and not grown:
+            if log.exists():
+                lines = log.read_text().splitlines()
+                if any(ln.startswith("STEP rank=0 size=2 step=6")
+                       for ln in lines):
+                    hostsfile.write_text(
+                        f"localhost:2\n127.0.0.1:2\n{my_ip}:2\n")
+                    grown = True
+            time.sleep(0.5)
+        out, _ = proc.communicate(timeout=300)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, out
+    lines = log.read_text().splitlines()
+    assert "START rank=0 size=4 resume_step=0" in lines, lines
+    assert "CRASH rank=2 step=4" in lines, lines
+    # Shrink leg: np=2 restored from the step-4 orbax checkpoint.
+    assert "START rank=0 size=2 resume_step=4" in lines, lines
+    # Grow leg: back at np=4, restored from a later checkpoint.
+    grow_starts = [ln for ln in lines if ln.startswith(
+        "START rank=0 size=4 resume_step=") and
+        int(ln.rsplit("=", 1)[1]) > 4]
+    assert grow_starts, lines
+    assert any(ln.startswith("DONE rank=0 size=4 step=24")
+               for ln in lines), lines
+
+    # Loss continuity: every logged loss equals the uninterrupted oracle.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    rng = np.random.RandomState(7)
+    X = jnp.asarray(rng.randn(32, 4), jnp.float32)
+    y = jnp.asarray(rng.randn(32, 1), jnp.float32)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    params = {"w1": jax.random.normal(k1, (4, 8)) * 0.5,
+              "b1": jnp.zeros((8,)),
+              "w2": jax.random.normal(k2, (8, 1)) * 0.5,
+              "b2": jnp.zeros((1,))}
+
+    def loss_fn(p):
+        h = jnp.tanh(X @ p["w1"] + p["b1"])
+        return jnp.mean(((h @ p["w2"] + p["b2"]) - y) ** 2)
+
+    tx = optax.adam(5e-2)
+    opt_state = tx.init(params)
+    oracle = []
+    for _ in range(24):
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        oracle.append(float(loss))
+    logged = {}
+    for ln in lines:
+        if ln.startswith("STEP rank=0 "):
+            fields = dict(f.split("=") for f in ln.split()[1:])
+            logged[int(fields["step"])] = float(fields["loss"])
+    assert logged, lines
+    for step, loss in sorted(logged.items()):
+        assert abs(loss - oracle[step]) < 1e-5, (
+            f"step {step}: logged {loss} vs oracle {oracle[step]} — "
+            "state did not survive the restart")
+
+
+def test_host_hash_stable_and_overridable(monkeypatch):
+    from horovod_tpu.runner.hosts import host_hash
+    a = host_hash()
+    assert a == host_hash() and len(a) == 32
+    monkeypatch.setenv("HOROVOD_HOSTNAME", "shared-fs-node")
+    b = host_hash()
+    assert b != a
+    assert host_hash(salt="split") != b
