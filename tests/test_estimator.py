@@ -316,9 +316,15 @@ data = ParquetBatches({path!r}, batch_rows=4096) if {streaming} \\
 est.fit(data)
 print("PEAK", resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
 """
+        # Sanitize the child env: under the suite the parent carries
+        # XLA_FLAGS=--xla_force_host_platform_device_count=8, which would
+        # override the child's own 1-device setup and swamp the RSS
+        # comparison with multi-device buffers.
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
         res = subprocess.run([sys.executable, "-c", code],
                              capture_output=True, text=True, timeout=600,
-                             cwd=REPO)
+                             cwd=REPO, env=env)
         assert res.returncode == 0, res.stdout + res.stderr
         line = [ln for ln in res.stdout.splitlines()
                 if ln.startswith("PEAK")][-1]
