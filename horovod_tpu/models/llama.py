@@ -30,7 +30,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import sharding as shd
 from ..parallel.moe import moe_layer_local
-from ..parallel.ring_attention import ring_attention_local
+from ..parallel.ring_attention import (
+    ring_attention_local,
+    ulysses_attention_local,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,6 +58,17 @@ class LlamaConfig:
     # middle ground for configs that don't fit with remat=False.
     remat: Any = True
     moe_aux_weight: float = 0.01
+    # pp microbatch count (None = auto: most M <= 2*pp dividing the local
+    # batch).  More microbatches shrink the pipeline bubble
+    # ((pp-1)/(M+pp-1) for both schedules); 1F1B keeps activation memory
+    # flat in M, so large M is cheap there.
+    pp_microbatches: Optional[int] = None
+    # Sequence-parallel attention flavor on sp>1 meshes: "ring" (blockwise
+    # KV rotation over ppermute — memory O(local_seq^2), any head count)
+    # or "ulysses" (all_to_all heads<->sequence swap — full-sequence
+    # attention on a head subset; needs local heads divisible by sp,
+    # preferable when heads >> sp and the sequence fits).
+    sp_attention: str = "ring"
     # Blockwise (online-softmax) cross-entropy (ops/losses.py): trades
     # one extra lm_head matmul for never materializing the [B,S,V] fp32
     # logits.  Measured on TPU v5 lite (d1024/L8, B=8, S=1024, V=32000):
@@ -258,16 +272,28 @@ def _flash_backend() -> bool:
     return jax.default_backend() == "tpu" or _FORCE_FLASH_INTERPRET
 
 
-def _attention(q, k, v, mesh: Optional[Mesh], causal: bool) -> jax.Array:
-    """Dispatch: ring attention when the sequence is sp-sharded; the Pallas
-    flash kernel on TPU for supported shapes (shard_mapped over the mesh so
-    each chip runs the kernel on its own batch/head shard — a bare
-    pallas_call has no GSPMD partitioning rule and would be replicated);
-    dense XLA otherwise."""
+def _sp_local_attention(sp_mode: str):
+    """The mapped-context sequence-parallel attention for ``sp_mode``."""
+    if sp_mode == "ulysses":
+        return ulysses_attention_local
+    if sp_mode == "ring":
+        return ring_attention_local
+    raise ValueError(f"unknown sp_attention {sp_mode!r} "
+                     "(expected 'ring' or 'ulysses')")
+
+
+def _attention(q, k, v, mesh: Optional[Mesh], causal: bool,
+               sp_mode: str = "ring") -> jax.Array:
+    """Dispatch: ring/Ulysses attention when the sequence is sp-sharded;
+    the Pallas flash kernel on TPU for supported shapes (shard_mapped over
+    the mesh so each chip runs the kernel on its own batch/head shard — a
+    bare pallas_call has no GSPMD partitioning rule and would be
+    replicated); dense XLA otherwise."""
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
     if sp > 1:
         fn = shard_map(
-            partial(ring_attention_local, axis_name="sp", causal=causal),
+            partial(_sp_local_attention(sp_mode), axis_name="sp",
+                    causal=causal),
             mesh=mesh,
             in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
             out_specs=P(None, "sp"),
@@ -343,12 +369,15 @@ def _moe_mlp(h2, lp, cfg: LlamaConfig, mesh: Optional[Mesh]):
     return out.reshape(B, S, D), aux
 
 
-def _pick_microbatches(batch: int, mesh: Mesh) -> int:
-    """Most microbatches <= 2*pp that divide the LOCAL batch (GPipe
-    bubble (S-1)/(M+S-1); callers with large batches get M = 2*pp).  The
-    microbatch split happens inside the manual region on per-device
-    arrays, so M must divide batch/(dp*fsdp*ep); ep counts as a data axis
-    there so MoE dispatch sees distinct local tokens per ep rank."""
+def _pick_microbatches(batch: int, mesh: Mesh,
+                       requested: Optional[int] = None) -> int:
+    """Microbatch count for the pipeline: ``requested``
+    (cfg.pp_microbatches) when set, else the most <= 2*pp that divides
+    the LOCAL batch (GPipe bubble (S-1)/(M+S-1); callers with large
+    batches get M = 2*pp).  The microbatch split happens inside the
+    manual region on per-device arrays, so M must divide
+    batch/(dp*fsdp*ep); ep counts as a data axis there so MoE dispatch
+    sees distinct local tokens per ep rank."""
     pp = mesh.shape.get("pp", 1)
     df = (mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
           * mesh.shape.get("ep", 1))
@@ -356,6 +385,12 @@ def _pick_microbatches(batch: int, mesh: Mesh) -> int:
         raise ValueError(
             f"global batch {batch} must divide over dp*fsdp*ep = {df}")
     local = batch // df
+    if requested is not None:
+        if requested < 1 or local % requested:
+            raise ValueError(
+                f"pp_microbatches={requested} must divide the local batch "
+                f"{local} (= global {batch} / dp*fsdp*ep {df})")
+        return requested
     for m in range(min(2 * pp, local), 0, -1):
         if local % m == 0:
             return m
@@ -400,8 +435,8 @@ def _pp_machinery(cfg: LlamaConfig, mesh: Mesh, causal: bool, S: int) -> dict:
 
     def attention(q, k, v):
         if sp > 1:
-            return ring_attention_local(q, k, v, axis_name="sp",
-                                        causal=causal)
+            return _sp_local_attention(cfg.sp_attention)(
+                q, k, v, axis_name="sp", causal=causal)
         if _flash_backend() and FA.supported(q.shape, q.dtype.itemsize):
             return FA.flash_attention(q, k, v, None, causal, None, None,
                                       _FORCE_FLASH_INTERPRET)
@@ -521,7 +556,7 @@ def _forward_pipelined(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     D = cfg.d_model
     h = _embed_lookup(params["embed"], tokens, cfg.dtype)   # [B,S,D]
     h = shd.constrain(h, ("batch", "seq", None), mesh)
-    M = _pick_microbatches(B, mesh)
+    M = _pick_microbatches(B, mesh, cfg.pp_microbatches)
 
     def local(local_layers, h_loc):
         # The microbatch split happens HERE, on the local shard: splitting
@@ -581,7 +616,8 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, *,
             lp = {k: shd.constrain(v, layer_dims[k], mesh)
                   for k, v in lp.items()}
         h = _attn_block(h, lp, rope, cfg,
-                        lambda q, k, v: _attention(q, k, v, mesh, causal))
+                        lambda q, k, v: _attention(q, k, v, mesh, causal,
+                                                   cfg.sp_attention))
         x2 = _rmsnorm(h, lp["mlp_norm"])
         if cfg.use_moe:
             mlp_out, moe_aux = _moe_mlp(x2, lp, cfg, mesh)
@@ -696,7 +732,7 @@ def _make_train_step_1f1b(cfg: LlamaConfig, mesh: Mesh, tx):
         D = cfg.d_model
         parts = _pp_machinery(cfg, mesh, True, S)
         make_stage_fn, S_loc = parts["make_stage_fn"], parts["S_loc"]
-        M = _pick_microbatches(B, mesh)
+        M = _pick_microbatches(B, mesh, cfg.pp_microbatches)
 
         def embed_fn(emb):
             h = _embed_lookup(emb, inputs, cfg.dtype)

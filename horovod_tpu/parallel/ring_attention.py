@@ -135,20 +135,22 @@ def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
     n = lax.axis_size(axis_name)
     B, L, H, D = q.shape
     if H % n:
-        raise ValueError(f"heads ({H}) must divide sp size ({n}) for Ulysses")
+        raise ValueError(
+            f"sp size ({n}) must divide heads ({H}) for Ulysses")
 
+    # tiled=True all_to_alls: split_axis chunked across the axis, concat
+    # axis grown n-fold, no intermediate block reshapes.  (The tiled=False
+    # block formulation had a broken transpose on this jax — the vjp's
+    # cotangent came back mis-shaped when split_axis != concat_axis, which
+    # only surfaced once the model grew a differentiated Ulysses path.)
     def seq_to_heads(x):
         # [B, L, H, D] local-seq → [B, n*L, H/n, D] local-heads
-        blocks = x.reshape(B, L, n, H // n, D)
-        swapped = lax.all_to_all(blocks, axis_name, split_axis=2,
-                                 concat_axis=1, tiled=False)
-        return swapped.reshape(B, n * L, H // n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
 
     def heads_to_seq(x):
-        blocks = x.reshape(B, n, L, H // n, D)
-        swapped = lax.all_to_all(blocks, axis_name, split_axis=1,
-                                 concat_axis=2, tiled=False)
-        return swapped.reshape(B, L, H, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     if scale is None:

@@ -316,12 +316,14 @@ data = ParquetBatches({path!r}, batch_rows=4096) if {streaming} \\
 est.fit(data)
 print("PEAK", resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
 """
-        # Sanitize the child env: under the suite the parent carries
-        # XLA_FLAGS=--xla_force_host_platform_device_count=8, which would
-        # override the child's own 1-device setup and swamp the RSS
-        # comparison with multi-device buffers.
-        env = {k: v for k, v in os.environ.items()
-               if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+        # Controlled child env (allowlist): a measurement subprocess
+        # must not inherit whatever XLA/JAX/HVDTPU knobs earlier tests
+        # exported into the suite process — leaked flags reproducibly
+        # inflated both paths' RSS by ~1 GB under the full suite while
+        # standalone runs passed.
+        keep = ("PATH", "PYTHONPATH", "HOME", "TMPDIR",
+                "LD_LIBRARY_PATH", "LANG")
+        env = {k: os.environ[k] for k in keep if k in os.environ}
         res = subprocess.run([sys.executable, "-c", code],
                              capture_output=True, text=True, timeout=600,
                              cwd=REPO, env=env)

@@ -4,6 +4,7 @@ Covers the mesh layouts the multi-chip dry run exercises: dp×sp×tp,
 dp×ep×tp (MoE), and dp×pp×tp (layer stack over pp).
 """
 
+import dataclasses
 import os
 
 import jax
@@ -337,9 +338,9 @@ def test_pp_flash_attention_matches_dense():
             rtol=5e-3, atol=5e-4, err_msg=key)
 
 
-def _train_losses(mesh_cfg, n_steps=4, seed=0, schedule="1f1b"):
+def _train_losses(mesh_cfg, n_steps=4, seed=0, schedule="1f1b", cfg=None):
     mesh = build_mesh(mesh_cfg)
-    cfg = llama.LlamaConfig.tiny()
+    cfg = cfg or llama.LlamaConfig.tiny()
     params = llama.init_params(cfg, jax.random.PRNGKey(seed), mesh)
     tx = optax.adam(1e-2)
     opt_state = jax.jit(tx.init)(params)
@@ -427,3 +428,57 @@ def test_pp_fsdp_matches_dp_oracle(schedule):
     pf_losses, _, _ = _train_losses(MeshConfig(pp=2, fsdp=2, tp=2),
                                     n_steps=3, schedule=schedule)
     np.testing.assert_allclose(dp_losses, pf_losses, rtol=1e-3)
+
+
+def test_ulysses_vs_dense_attention_in_model():
+    """sp_attention="ulysses": the all_to_all heads<->sequence swap in the
+    model's sp path must match the dense single-axis forward (the ring
+    counterpart is test_ring_vs_dense_attention_in_model)."""
+    cfg = llama.LlamaConfig.tiny(sp_attention="ulysses",
+                                 n_heads=8, n_kv_heads=8, d_model=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(2))
+    tokens = _batch(cfg, B=2, S=32)["tokens"][:, :-1]
+    dense_logits, _ = llama.forward(
+        params, tokens, dataclasses.replace(cfg, sp_attention="ring"))
+
+    mesh = build_mesh(MeshConfig(sp=8))
+    params_s = jax.device_put(params, llama.param_shardings(cfg, mesh))
+    uly_logits, _ = jax.jit(
+        lambda p, t: llama.forward(p, t, cfg, mesh=mesh))(params_s, tokens)
+    np.testing.assert_allclose(np.asarray(dense_logits),
+                               np.asarray(uly_logits),
+                               rtol=5e-3, atol=5e-4)
+
+
+def test_pp_sp_ulysses_matches_dp_oracle():
+    """pp x sp with Ulysses attention inside the manual pipeline region."""
+    dp_losses, _, _ = _train_losses(MeshConfig(dp=8), n_steps=3)
+    uly_losses, _, _ = _train_losses(
+        MeshConfig(pp=2, sp=2, dp=2), n_steps=3,
+        cfg=llama.LlamaConfig.tiny(sp_attention="ulysses"))
+    np.testing.assert_allclose(dp_losses, uly_losses, rtol=1e-3)
+
+
+def test_sp_ulysses_training_matches_dp_oracle():
+    """Ulysses BACKWARD on a plain sp mesh (the tiled all_to_all transpose
+    — the block form's vjp came back mis-shaped; forward-only tests never
+    caught it)."""
+    dp_losses, _, _ = _train_losses(MeshConfig(dp=8), n_steps=3)
+    uly_losses, _, _ = _train_losses(
+        MeshConfig(sp=4, dp=2), n_steps=3,
+        cfg=llama.LlamaConfig.tiny(sp_attention="ulysses"))
+    np.testing.assert_allclose(dp_losses, uly_losses, rtol=1e-3)
+
+
+def test_pp_microbatches_knob():
+    """cfg.pp_microbatches overrides the auto microbatch count (bubble
+    tuning; 1F1B memory is flat in M) and validates divisibility."""
+    dp_losses, _, _ = _train_losses(MeshConfig(dp=8), n_steps=2)
+    m4_losses, _, _ = _train_losses(
+        MeshConfig(pp=2, dp=2, tp=2), n_steps=2,
+        cfg=llama.LlamaConfig.tiny(pp_microbatches=4))  # local batch 4
+    np.testing.assert_allclose(dp_losses, m4_losses, rtol=1e-4)
+
+    with pytest.raises(ValueError, match="pp_microbatches"):
+        _train_losses(MeshConfig(pp=2, dp=2, tp=2), n_steps=1,
+                      cfg=llama.LlamaConfig.tiny(pp_microbatches=3))
