@@ -1,8 +1,11 @@
 """Llama fine-tune with full multi-axis parallelism — BASELINE config 4.
 
 Pick the mesh for your hardware: dp for batch, tp for per-layer sharding,
-sp for long context (ring attention), pp for depth, ep for MoE.  On a
-v5p-64 (64 chips): e.g. MeshConfig(dp=4, tp=8, sp=2) for 7B long-context.
+sp for long context (ring attention by default; Ulysses via
+``LlamaConfig(sp_attention="ulysses")``), pp for depth (1F1B schedule by
+default; tune the bubble with ``pp_microbatches``), ep for MoE.  On a
+v5p-64 (64 chips): e.g. MeshConfig(dp=4, tp=8, sp=2) for 7B long-context,
+or MeshConfig(pp=4, dp=4, tp=4) for depth-heavy models.
 
 Demo shapes run anywhere:
 

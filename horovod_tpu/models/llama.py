@@ -641,6 +641,118 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, *,
     return logits.astype(jnp.float32), aux
 
 
+def _layer_kv(x, lp, rope, cfg: LlamaConfig):
+    """Post-RoPE K/V for a normed input chunk (no GQA expand — the cache
+    stores kv_heads and expands at attention time)."""
+    k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"])
+    return _rope(k, rope), v
+
+
+def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig, *,
+             max_new_tokens: int, mesh: Optional[Mesh] = None) -> jax.Array:
+    """Greedy autoregressive decoding with a per-layer KV cache.
+
+    ``prompt``: [B, P] int32.  Returns [B, P + max_new_tokens] — the
+    prompt with the greedy continuation appended.  Prefill runs the layer
+    stack once over the prompt (causal, batched — MXU-shaped); decode is a
+    ``lax.scan`` over new tokens, each step attending to the cache and
+    appending its own K/V (O(T·L·cache) instead of re-running the full
+    forward per token).  Works pure (mesh=None) or under GSPMD meshes
+    whose axes are automatic (dp/fsdp/tp); the manual-collective axes
+    (pp/sp/ep) need the training paths and are rejected here.
+    """
+    if mesh is not None and any(
+            mesh.shape.get(a, 1) > 1 for a in ("pp", "sp", "ep")):
+        raise NotImplementedError(
+            "generate supports dp/fsdp/tp meshes; pp/sp/ep are "
+            "training-path axes")
+    if cfg.use_moe:
+        raise NotImplementedError("generate does not support MoE configs")
+    B, P = prompt.shape
+    T = P + max_new_tokens
+    KV, Dh = cfg.n_kv_heads, cfg.head_dim
+    rep = cfg.n_heads // cfg.n_kv_heads
+    scale = 1.0 / np.sqrt(Dh)
+    L = cfg.n_layers
+
+    def attend(q, keys, vals, mask):
+        # q [B,Sq,H,Dh]; keys/vals [B,T,KV,Dh]; mask [Sq,T] bool.
+        if rep != 1:
+            keys = jnp.repeat(keys, rep, axis=2)
+            vals = jnp.repeat(vals, rep, axis=2)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, keys
+                       ).astype(jnp.float32) * scale
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(vals.dtype), vals)
+
+    # ---- prefill: build the cache over the prompt ----------------------
+    h = _embed_lookup(params["embed"], prompt, cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(P), (B, P))
+    rope_p = _rope_tables(positions, cfg.rope_theta, cfg.head_dim)
+    prefill_mask = jnp.tril(jnp.ones((P, P), bool))
+
+    def prefill_layer(h, lp):
+        x = _rmsnorm(h, lp["attn_norm"])
+        q = _rope(jnp.einsum("bsd,dhk->bshk", x, lp["wq"]), rope_p)
+        k, v = _layer_kv(x, lp, rope_p, cfg)
+        # Attention over the P prompt keys only; the T-length cache is
+        # written separately (attending into the zero-padded cache would
+        # pay T/P times the prefill score FLOPs on masked positions).
+        attn = attend(q, k, v, prefill_mask)
+        ck = jnp.zeros((B, T, KV, Dh), cfg.dtype).at[:, :P].set(k)
+        cv = jnp.zeros((B, T, KV, Dh), cfg.dtype).at[:, :P].set(v)
+        h = h + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+        h = h + _dense_mlp(_rmsnorm(h, lp["mlp_norm"]), lp)
+        return h, (ck, cv)
+
+    h, (cache_k, cache_v) = lax.scan(prefill_layer, h, params["layers"])
+    logits = jnp.einsum("bd,dv->bv",
+                        _rmsnorm(h[:, -1], params["final_norm"]),
+                        params["lm_head"]).astype(jnp.float32)
+    first_new = jnp.argmax(logits, axis=-1).astype(prompt.dtype)  # [B]
+
+    # ---- decode: one token per tick, cache append ----------------------
+    def decode_step(carry, _):
+        cache_k, cache_v, tok, pos = carry
+        h = _embed_lookup(params["embed"], tok[:, None], cfg.dtype)
+        rope_1 = _rope_tables(
+            jnp.broadcast_to(pos[None, None], (B, 1)),
+            cfg.rope_theta, cfg.head_dim)
+        mask = (jnp.arange(T) <= pos)[None, :]          # [1, T]
+
+        def layer(h, inputs):
+            lp, ck, cv = inputs
+            x = _rmsnorm(h, lp["attn_norm"])
+            q = _rope(jnp.einsum("bsd,dhk->bshk", x, lp["wq"]), rope_1)
+            k1, v1 = _layer_kv(x, lp, rope_1, cfg)
+            ck = lax.dynamic_update_slice(ck, k1, (0, pos, 0, 0))
+            cv = lax.dynamic_update_slice(cv, v1, (0, pos, 0, 0))
+            attn = attend(q, ck, cv, mask)
+            h = h + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+            h = h + _dense_mlp(_rmsnorm(h, lp["mlp_norm"]), lp)
+            return h, (ck, cv)
+
+        h, (cache_k, cache_v) = lax.scan(
+            layer, h, (params["layers"], cache_k, cache_v))
+        logits = jnp.einsum("bd,dv->bv",
+                            _rmsnorm(h[:, 0], params["final_norm"]),
+                            params["lm_head"]).astype(jnp.float32)
+        nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+        return (cache_k, cache_v, nxt, pos + 1), nxt
+
+    # max_new_tokens - 1 decode steps: the first new token came from the
+    # prefill logits, and collecting each step's OUTPUT token means no
+    # trailing step whose result would be discarded.
+    carry0 = (cache_k, cache_v, first_new, jnp.asarray(P, jnp.int32))
+    _, toks = lax.scan(decode_step, carry0, None,
+                       length=max_new_tokens - 1)
+    new_toks = jnp.concatenate([first_new[:, None], toks.swapaxes(0, 1)],
+                               axis=1)
+    return jnp.concatenate([prompt, new_toks], axis=1)
+
+
 def _use_blockwise_ce(cfg: LlamaConfig, mesh: Optional[Mesh]) -> bool:
     if not cfg.blockwise_ce:
         return False

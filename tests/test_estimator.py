@@ -287,40 +287,58 @@ def test_store_create_flavors(tmp_path):
 
 @pytest.mark.integration
 def test_streaming_fit_peak_rss_below_materialized(tmp_path):
-    """The streaming promise, measured: fitting a ~200 MB parquet through
-    ParquetBatches must peak well below the same fit through the
-    materializing to_columns path (VERDICT r3 #6: dataset larger than a
-    collect must be trainable; peak-RSS asserted)."""
+    """The streaming promise, measured: fitting a ~400 MB parquet through
+    ParquetBatches must not grow the process by anywhere near the dataset
+    size, while the materializing to_columns path must (VERDICT r3 #6:
+    dataset larger than a collect must be trainable; peak-RSS asserted).
+
+    Measured as the DELTA between each child's post-import/post-jax-warmup
+    high-water mark and its post-fit high-water mark: absolute peaks vary
+    by ~1 GB with system memory pressure (allocator/THP behavior when the
+    suite parent is large), but the fit-phase growth is the property under
+    test and is stable."""
     import subprocess
     import sys
     path = str(tmp_path / "big.parquet")
-    # ~400 MB of float32 features: big enough that the materialized
-    # path's full copies dominate allocator noise in the RSS comparison.
-    _write_multi_rowgroup_parquet(path, n_rows=400_000, n_feat=256,
+    # ~2 GB of float32 features: XLA's compile-phase RSS peak varies by
+    # up to ~1.3 GB with thread timing, so the dataset must dwarf it for
+    # the delta comparison to be about data and nothing else.
+    _write_multi_rowgroup_parquet(path, n_rows=2_000_000, n_feat=256,
+                                  rows_per_group=16384)
+    # Same schema/shapes at toy size: the child fits this FIRST so the
+    # train-step compile (whose XLA peak varies by hundreds of MB with
+    # thread timing) lands in the baseline, not the measured delta.
+    warm = str(tmp_path / "warm.parquet")
+    _write_multi_rowgroup_parquet(warm, n_rows=8192, n_feat=256,
                                   rows_per_group=8192)
 
-    def peak_rss(streaming: bool) -> int:
+    def fit_rss_delta(streaming: bool) -> int:
         code = f"""
 import resource, sys
+import numpy as np
 sys.path.insert(0, {REPO!r})
 from horovod_tpu.utils.cpurig import force_cpu_platform
 force_cpu_platform(1)
+import jax, jax.numpy as jnp
 import optax
 from horovod_tpu.estimator import JaxEstimator, ParquetBatches
 from tests.test_estimator import _Linear
-est = JaxEstimator(model=_Linear(), feature_cols=["features"],
-                   label_cols=["label"], loss="mse", batch_size=512,
-                   epochs=1, seed=0, optimizer=optax.adam(0.1))
-data = ParquetBatches({path!r}, batch_rows=4096) if {streaming} \\
+def make_est():
+    return JaxEstimator(model=_Linear(), feature_cols=["features"],
+                        label_cols=["label"], loss="mse", batch_size=512,
+                        epochs=1, seed=0, optimizer=optax.adam(0.1))
+# Identical-shape warmup fit: the train-step compile (XLA peak varies
+# hundreds of MB with thread timing) lands in the baseline.
+warm_data = ParquetBatches({warm!r}, batch_rows=4096) if {streaming} \
+    else {warm!r}
+make_est().fit(warm_data)
+base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+data = ParquetBatches({path!r}, batch_rows=4096) if {streaming} \
     else {path!r}
-est.fit(data)
-print("PEAK", resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+make_est().fit(data)
+peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+print("DELTA", peak - base)
 """
-        # Controlled child env (allowlist): a measurement subprocess
-        # must not inherit whatever XLA/JAX/HVDTPU knobs earlier tests
-        # exported into the suite process — leaked flags reproducibly
-        # inflated both paths' RSS by ~1 GB under the full suite while
-        # standalone runs passed.
         keep = ("PATH", "PYTHONPATH", "HOME", "TMPDIR",
                 "LD_LIBRARY_PATH", "LANG")
         env = {k: os.environ[k] for k in keep if k in os.environ}
@@ -329,13 +347,19 @@ print("PEAK", resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
                              cwd=REPO, env=env)
         assert res.returncode == 0, res.stdout + res.stderr
         line = [ln for ln in res.stdout.splitlines()
-                if ln.startswith("PEAK")][-1]
+                if ln.startswith("DELTA")][-1]
         return int(line.split()[1])  # KiB on linux
 
-    stream_kib = peak_rss(True)
-    full_kib = peak_rss(False)
-    # The materializing path holds >= 1 full dataset copy (~400 MB)
-    # beyond the streaming path's single chunk.
-    assert stream_kib < full_kib - 250 * 1024, (
-        f"streaming peak {stream_kib} KiB not below materialized "
-        f"{full_kib} KiB by 250 MiB")
+    stream_kib = fit_rss_delta(True)
+    full_kib = fit_rss_delta(False)
+    # Dataset is ~2 GB: the materializing path must grow by at least one
+    # full copy; the streaming path by far less than the dataset.
+    assert full_kib > 1800 * 1024, (
+        f"materialized fit grew only {full_kib} KiB — dataset no longer "
+        "dominates; rescale the test")
+    assert stream_kib < 700 * 1024, (
+        f"streaming fit grew {stream_kib} KiB (a third of the dataset) — "
+        "something materialized")
+    assert stream_kib < full_kib - 1024 * 1024, (
+        f"streaming delta {stream_kib} KiB not below materialized "
+        f"{full_kib} KiB by 1 GiB")

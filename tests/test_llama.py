@@ -482,3 +482,42 @@ def test_pp_microbatches_knob():
     with pytest.raises(ValueError, match="pp_microbatches"):
         _train_losses(MeshConfig(pp=2, dp=2, tp=2), n_steps=1,
                       cfg=llama.LlamaConfig.tiny(pp_microbatches=3))
+
+
+def test_generate_matches_full_forward_greedy():
+    """KV-cache decoding oracle: generate() must emit exactly the tokens
+    that greedy decoding via repeated FULL forwards produces (prefill +
+    cached single-token steps = recompute-everything, token for token)."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(3))
+    rng = np.random.RandomState(5)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 8)), jnp.int32)
+
+    out = llama.generate(params, prompt, cfg, max_new_tokens=6)
+    assert out.shape == (2, 14)
+    np.testing.assert_array_equal(np.asarray(out[:, :8]),
+                                  np.asarray(prompt))
+
+    seq = prompt
+    for _ in range(6):
+        logits, _ = llama.forward(params, seq, cfg)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_generate_gqa_and_mesh():
+    """generate with GQA heads and under a dp/tp GSPMD mesh; manual-axis
+    meshes are rejected."""
+    cfg = llama.LlamaConfig.tiny(n_heads=4, n_kv_heads=2)
+    mesh = build_mesh(MeshConfig(dp=4, tp=2))
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), mesh)
+    rng = np.random.RandomState(1)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 5)), jnp.int32)
+    out = llama.generate(params, prompt, cfg, max_new_tokens=4, mesh=mesh)
+    assert out.shape == (4, 9)
+    assert np.isfinite(np.asarray(out)).all()
+
+    with pytest.raises(NotImplementedError, match="pp/sp/ep"):
+        llama.generate(params, prompt, cfg, max_new_tokens=2,
+                       mesh=build_mesh(MeshConfig(sp=8)))
