@@ -641,7 +641,7 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, *,
     return logits.astype(jnp.float32), aux
 
 
-def _layer_kv(x, lp, rope, cfg: LlamaConfig):
+def _layer_kv(x, lp, rope):
     """Post-RoPE K/V for a normed input chunk (no GQA expand — the cache
     stores kv_heads and expands at attention time)."""
     k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"])
@@ -650,11 +650,15 @@ def _layer_kv(x, lp, rope, cfg: LlamaConfig):
 
 
 def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig, *,
-             max_new_tokens: int, mesh: Optional[Mesh] = None) -> jax.Array:
-    """Greedy autoregressive decoding with a per-layer KV cache.
+             max_new_tokens: int, mesh: Optional[Mesh] = None,
+             temperature: float = 0.0,
+             key: Optional[jax.Array] = None) -> jax.Array:
+    """Autoregressive decoding with a per-layer KV cache.
 
     ``prompt``: [B, P] int32.  Returns [B, P + max_new_tokens] — the
-    prompt with the greedy continuation appended.  Prefill runs the layer
+    prompt with the continuation appended.  ``temperature == 0`` (the
+    default) decodes greedily; ``temperature > 0`` samples from
+    ``softmax(logits / temperature)`` using ``key`` (required then).  Prefill runs the layer
     stack once over the prompt (causal, batched — MXU-shaped); decode is a
     ``lax.scan`` over new tokens, each step attending to the cache and
     appending its own K/V (O(T·L·cache) instead of re-running the full
@@ -671,6 +675,11 @@ def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig, *,
         raise NotImplementedError("generate does not support MoE configs")
     B, P = prompt.shape
     T = P + max_new_tokens
+    if temperature > 0.0 and key is None:
+        raise ValueError("temperature > 0 requires a PRNG key")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got "
+                         f"{max_new_tokens}")
     KV, Dh = cfg.n_kv_heads, cfg.head_dim
     rep = cfg.n_heads // cfg.n_kv_heads
     scale = 1.0 / np.sqrt(Dh)
@@ -696,7 +705,7 @@ def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig, *,
     def prefill_layer(h, lp):
         x = _rmsnorm(h, lp["attn_norm"])
         q = _rope(jnp.einsum("bsd,dhk->bshk", x, lp["wq"]), rope_p)
-        k, v = _layer_kv(x, lp, rope_p, cfg)
+        k, v = _layer_kv(x, lp, rope_p)
         # Attention over the P prompt keys only; the T-length cache is
         # written separately (attending into the zero-padded cache would
         # pay T/P times the prefill score FLOPs on masked positions).
@@ -708,13 +717,22 @@ def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig, *,
         return h, (ck, cv)
 
     h, (cache_k, cache_v) = lax.scan(prefill_layer, h, params["layers"])
+    def pick(logits, k):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            k, logits / temperature, axis=-1).astype(prompt.dtype)
+
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused when greedy
+    key, k0 = jax.random.split(key)
     logits = jnp.einsum("bd,dv->bv",
                         _rmsnorm(h[:, -1], params["final_norm"]),
                         params["lm_head"]).astype(jnp.float32)
-    first_new = jnp.argmax(logits, axis=-1).astype(prompt.dtype)  # [B]
+    first_new = pick(logits, k0)                                  # [B]
 
     # ---- decode: one token per tick, cache append ----------------------
-    def decode_step(carry, _):
+    def decode_step(carry, step_key):
         cache_k, cache_v, tok, pos = carry
         h = _embed_lookup(params["embed"], tok[:, None], cfg.dtype)
         rope_1 = _rope_tables(
@@ -726,7 +744,7 @@ def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig, *,
             lp, ck, cv = inputs
             x = _rmsnorm(h, lp["attn_norm"])
             q = _rope(jnp.einsum("bsd,dhk->bshk", x, lp["wq"]), rope_1)
-            k1, v1 = _layer_kv(x, lp, rope_1, cfg)
+            k1, v1 = _layer_kv(x, lp, rope_1)
             ck = lax.dynamic_update_slice(ck, k1, (0, pos, 0, 0))
             cv = lax.dynamic_update_slice(cv, v1, (0, pos, 0, 0))
             attn = attend(q, ck, cv, mask)
@@ -739,15 +757,15 @@ def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig, *,
         logits = jnp.einsum("bd,dv->bv",
                             _rmsnorm(h[:, 0], params["final_norm"]),
                             params["lm_head"]).astype(jnp.float32)
-        nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+        nxt = pick(logits, step_key)
         return (cache_k, cache_v, nxt, pos + 1), nxt
 
     # max_new_tokens - 1 decode steps: the first new token came from the
     # prefill logits, and collecting each step's OUTPUT token means no
     # trailing step whose result would be discarded.
     carry0 = (cache_k, cache_v, first_new, jnp.asarray(P, jnp.int32))
-    _, toks = lax.scan(decode_step, carry0, None,
-                       length=max_new_tokens - 1)
+    _, toks = lax.scan(decode_step, carry0,
+                       jax.random.split(key, max_new_tokens - 1))
     new_toks = jnp.concatenate([first_new[:, None], toks.swapaxes(0, 1)],
                                axis=1)
     return jnp.concatenate([prompt, new_toks], axis=1)

@@ -521,3 +521,27 @@ def test_generate_gqa_and_mesh():
     with pytest.raises(NotImplementedError, match="pp/sp/ep"):
         llama.generate(params, prompt, cfg, max_new_tokens=2,
                        mesh=build_mesh(MeshConfig(sp=8)))
+
+
+def test_generate_temperature_sampling():
+    """temperature=0 is greedy; temperature>0 samples reproducibly from
+    the key and stays in-vocab."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(3))
+    prompt = jnp.asarray(np.random.RandomState(5).randint(
+        0, cfg.vocab_size, (2, 8)), jnp.int32)
+    greedy = llama.generate(params, prompt, cfg, max_new_tokens=5)
+    greedy0 = llama.generate(params, prompt, cfg, max_new_tokens=5,
+                             temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(greedy0))
+    k = jax.random.PRNGKey(11)
+    s1 = llama.generate(params, prompt, cfg, max_new_tokens=5,
+                        temperature=1.0, key=k)
+    s2 = llama.generate(params, prompt, cfg, max_new_tokens=5,
+                        temperature=1.0, key=k)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    assert (np.asarray(s1) < cfg.vocab_size).all()
+    assert (np.asarray(s1) >= 0).all()
+    with pytest.raises(ValueError, match="PRNG key"):
+        llama.generate(params, prompt, cfg, max_new_tokens=2,
+                       temperature=0.8)
