@@ -135,14 +135,18 @@ def _flagship_tokens():
         0, llama.LlamaConfig.tiny().vocab_size, (8, 33))
 
 
-def test_run_func_flagship_on_multiprocess_global_mesh():
+@pytest.mark.parametrize("axis", ["dp", "tp", "pp"])
+def test_run_func_flagship_on_multiprocess_global_mesh(axis):
     """The real multi-HOST path: two PROCESSES (one device each) form a
     jax.distributed global mesh and run the flagship's actual train step
-    over it — GSPMD gradient psums ride the cross-process collectives.
-    The 4-step loss trajectory must be bitwise-identical on both ranks
-    AND match the single-process dp=2 oracle computed in this test."""
+    over it — per axis, the collectives that cross the process boundary:
+    dp = GSPMD gradient psums, tp = per-layer Megatron all-gathers/psums,
+    pp = the pipeline's ppermute handoffs + the 1F1B cotangent returns
+    (the 'pp tolerates DCN' design claim, exercised for real).  The
+    4-step loss trajectory must be bitwise-identical on both ranks AND
+    match the single-process oracle on the same mesh shape."""
 
-    def work():
+    def work(axis):
         from horovod_tpu.utils.cpurig import force_cpu_platform
         force_cpu_platform(1)
         import jax
@@ -153,25 +157,26 @@ def test_run_func_flagship_on_multiprocess_global_mesh():
         from horovod_tpu.parallel import MeshConfig, build_mesh
 
         assert jax.device_count() == 2 and jax.process_count() == 2
-        mesh = build_mesh(MeshConfig(dp=2))
+        mesh = build_mesh(MeshConfig(**{axis: 2}))
         tokens = _flagship_tokens()
+        sharding = NamedSharding(mesh, P(("dp", "fsdp")))
         me = hvd.rank()
+        local = tokens[4 * me:4 * (me + 1)] if axis == "dp" else tokens
         batch = {"tokens": jax.make_array_from_process_local_data(
-            NamedSharding(mesh, P(("dp", "fsdp"))),
-            jnp.asarray(tokens[4 * me:4 * (me + 1)], jnp.int32), (8, 33))}
+            sharding, jnp.asarray(local, jnp.int32), (8, 33))}
         return _flagship_losses_on(mesh, batch)
 
-    res = run_func(work, np=2)
+    res = run_func(work, args=(axis,), np=2)
     assert res[0] == res[1], (res[0], res[1])
     assert res[0][-1] < res[0][0], res[0]
 
-    # Single-process dp=2 oracle on the same data, same shared loop.
+    # Single-process oracle on the same mesh shape and data.
     import jax
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
     from horovod_tpu.parallel import MeshConfig, build_mesh
-    mesh = build_mesh(MeshConfig(dp=2), devices=jax.devices()[:2])
+    mesh = build_mesh(MeshConfig(**{axis: 2}), devices=jax.devices()[:2])
     batch = {"tokens": jax.device_put(
         jnp.asarray(_flagship_tokens(), jnp.int32),
         NamedSharding(mesh, P(("dp", "fsdp"))))}
