@@ -4,14 +4,25 @@
 
 PY ?= python
 
-.PHONY: ci native test mp-test examples bench
+.PHONY: ci native test mp-test examples bench baseline-table image
 
 ci: native
 	$(PY) -c "import horovod_tpu, horovod_tpu.torch, horovod_tpu.tensorflow, \
 horovod_tpu.keras, horovod_tpu.elastic, horovod_tpu.spark, horovod_tpu.ray"
+	$(PY) benchmarks/baseline_table.py --check
 	$(PY) -m pytest tests -q -x --ignore=tests/test_runner.py
 	$(PY) -m pytest tests/test_runner.py -q -x
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# Regenerate BASELINE.md's measured table from benchmarks/measured.jsonl
+# (the jsonl is the source of truth; `--check` in CI fails on drift).
+baseline-table:
+	$(PY) benchmarks/baseline_table.py
+
+# Canonical pinned-environment image (docker/Dockerfile); context must be
+# the repo root so COPY sees the sources.
+image:
+	docker build -f docker/Dockerfile -t horovod-tpu .
 
 native:
 	$(MAKE) -C native
