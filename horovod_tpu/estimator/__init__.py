@@ -27,13 +27,14 @@ from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
-from .store import (FilesystemStore, LocalStore, ParquetBatches, Store,
-                    to_columns, train_val_split)
+from .store import (FilesystemStore, InMemoryObjectStore, LocalStore,
+                    ParquetBatches, RemoteStore, Store, to_columns,
+                    train_val_split)
 
 __all__ = [
     "JaxEstimator", "JaxModel", "KerasEstimator", "KerasModel",
-    "Store", "FilesystemStore", "LocalStore", "ParquetBatches",
-    "to_columns",
+    "Store", "FilesystemStore", "LocalStore", "RemoteStore",
+    "InMemoryObjectStore", "ParquetBatches", "to_columns",
 ]
 
 
@@ -268,6 +269,9 @@ class JaxEstimator:
             from ..utils.checkpoint import Checkpointer
             Checkpointer(self.store.checkpoint_path(self.run_id)) \
                 .save(epoch, {"params": params})
+            # Remote stores stage on local disk; publish each epoch's
+            # checkpoint so a crash never strands artifacts un-uploaded.
+            self.store.sync(self.run_id)
 
     def _fit_streaming(self, batches) -> JaxModel:
         """Fit from a :class:`~horovod_tpu.estimator.store.ParquetBatches`
@@ -429,6 +433,8 @@ class KerasEstimator:
             feats, labels, batch_size=self.batch_size, epochs=self.epochs,
             shuffle=self.shuffle, validation_data=val_data,
             callbacks=callbacks, verbose=self.verbose)
+        if self.store is not None and rank0:
+            self.store.sync(self.run_id)
         return KerasModel(model=self.model, feature_cols=self.feature_cols,
                           label_cols=self.label_cols,
                           history=getattr(history, "history", None))

@@ -69,6 +69,15 @@ class LlamaConfig:
     # attention on a head subset; needs local heads divisible by sp,
     # preferable when heads >> sp and the sequence fits).
     sp_attention: str = "ring"
+    # Unroll factor for the layer scan in the non-pipelined forward
+    # (lax.scan's ``unroll``).  1 = compile one layer body (fastest
+    # compile, depth-independent).  n_layers = fully unrolled: the
+    # stacked-residual dynamic-update-slice copies the rolled scan pays
+    # every layer (round-5 trace: 5.8 ms/step at the bench shape, pure
+    # copy traffic) disappear and XLA fuses across layer boundaries, at
+    # the cost of compile time linear in depth.  The bench config uses
+    # full unroll; deep configs should stay rolled or pick a divisor.
+    scan_unroll: int = 1
     # Blockwise (online-softmax) cross-entropy (ops/losses.py): trades
     # one extra lm_head matmul for never materializing the [B,S,V] fp32
     # logits.  Measured on TPU v5 lite (d1024/L8, B=8, S=1024, V=32000):
@@ -196,10 +205,44 @@ def _remat(body, mode):
     return jax.checkpoint(body) if mode else body
 
 
-def _rmsnorm(x: jax.Array, w: jax.Array, eps: float = 1e-5) -> jax.Array:
+def _rmsnorm_impl(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     x32 = x.astype(jnp.float32)
     rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
     return (x32 * rms * w).astype(x.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rmsnorm(x: jax.Array, w: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """RMSNorm with a hand-written VJP whose only residual is ``x``.
+
+    Autodiff of the plain version makes XLA save the fp32 normalized
+    activations for the backward — at the bench shape that is two
+    f32[B,S,D] tensors per layer (≈512 MB/step at d1024/L8/B8/S1024)
+    riding the layer-scan carry through HBM.  Recomputing the rsqrt from
+    the already-saved bf16 ``x`` in the backward is a handful of VPU ops
+    against ~2 ms/step of HBM traffic (round-5 trace: the fwd while
+    carried 2x f32[8,8,1024,1024] purely as norm residuals)."""
+    return _rmsnorm_impl(x, w, eps)
+
+
+def _rmsnorm_fwd(x, w, eps):
+    return _rmsnorm_impl(x, w, eps), (x, w)
+
+
+def _rmsnorm_bwd(eps, res, dy):
+    x, w = res
+    x32 = x.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    u = x32 * r                                   # normalized activations
+    du = dy.astype(jnp.float32) * w               # d(loss)/d(u)
+    s = jnp.mean(du * u, axis=-1, keepdims=True)
+    dx = (r * (du - u * s)).astype(x.dtype)
+    dw = jnp.sum(dy.astype(jnp.float32) * u,
+                 axis=tuple(range(x.ndim - 1))).astype(w.dtype)
+    return dx, dw
+
+
+_rmsnorm.defvjp(_rmsnorm_fwd, _rmsnorm_bwd)
 
 
 def _rope_tables(positions: jax.Array, theta: float, head_dim: int
@@ -238,19 +281,28 @@ def _embed_lookup(embed: jax.Array, tokens: jax.Array, dtype) -> jax.Array:
     return jnp.einsum("bsv,vd->bsd", onehot, embed.astype(dtype))
 
 
+def _gqa_expand(q, k, v):
+    """Materialize grouped K/V up to q's head count — only for attention
+    paths without native GQA indexing (dense oracle, ring/Ulysses sp);
+    the Pallas flash kernels index kv heads directly and never pay this
+    rep x HBM expansion."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
 def _attn_block(h, lp, rope, cfg: LlamaConfig, attention):
-    """Shared attention sub-block: RMSNorm -> QKV -> RoPE -> GQA expand ->
-    ``attention`` callable -> output projection + residual."""
+    """Shared attention sub-block: RMSNorm -> QKV -> RoPE -> ``attention``
+    callable (handed GROUPED K/V — each path expands only if it must) ->
+    output projection + residual."""
     x = _rmsnorm(h, lp["attn_norm"])
     q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"])
     k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"])
     v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"])
     q = _rope(q, rope)
     k = _rope(k, rope)
-    if cfg.n_kv_heads != cfg.n_heads:                  # GQA expand
-        rep = cfg.n_heads // cfg.n_kv_heads
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
     return h + jnp.einsum("bshk,hkd->bsd", attention(q, k, v), lp["wo"])
 
 
@@ -291,6 +343,7 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool,
     replicated); dense XLA otherwise."""
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
     if sp > 1:
+        k, v = _gqa_expand(q, k, v)   # ring/Ulysses rotate full head sets
         fn = shard_map(
             partial(_sp_local_attention(sp_mode), axis_name="sp",
                     causal=causal),
@@ -303,11 +356,12 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool,
     if _flash_backend():
         from ..ops import flash_attention as FA
         B, S, H, D = q.shape
+        KV = k.shape[2]
         if mesh is not None:
             dpf = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
             tp = mesh.shape.get("tp", 1)
             local = (B // max(dpf, 1), S, H // max(tp, 1), D)
-            if (B % dpf == 0 and H % tp == 0
+            if (B % dpf == 0 and H % tp == 0 and KV % tp == 0
                     and FA.supported(local, q.dtype.itemsize)):
                 spec = P(("dp", "fsdp"), None, "tp", None)
                 fn = shard_map(
@@ -417,7 +471,6 @@ def _pp_machinery(cfg: LlamaConfig, mesh: Mesh, causal: bool, S: int) -> dict:
     from ..ops import flash_attention as FA
 
     S_loc = S // sp
-    rep = cfg.n_heads // cfg.n_kv_heads
     scale = 1.0 / np.sqrt(cfg.head_dim)
     layer_dims = {k: d[1:]
                   for k, d in param_logical_dims(cfg)["layers"].items()}
@@ -435,6 +488,7 @@ def _pp_machinery(cfg: LlamaConfig, mesh: Mesh, causal: bool, S: int) -> dict:
 
     def attention(q, k, v):
         if sp > 1:
+            k, v = _gqa_expand(q, k, v)
             return _sp_local_attention(cfg.sp_attention)(
                 q, k, v, axis_name="sp", causal=causal)
         if _flash_backend() and FA.supported(q.shape, q.dtype.itemsize):
@@ -473,9 +527,8 @@ def _pp_machinery(cfg: LlamaConfig, mesh: Mesh, causal: bool, S: int) -> dict:
         v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"])
         q = _rope(q, rope)
         k = _rope(k, rope)
-        if rep != 1:                                      # GQA expand
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        # K/V stay at kv_heads here; each attention path expands only if
+        # it must (the flash kernels index kv heads natively).
         attn_out = jnp.einsum("bshk,hkd->bsd", attention(q, k, v), lp["wo"])
         h = h + lax.psum(attn_out, "tp")                  # row-parallel wo
         x2 = _rmsnorm(h, lp["mlp_norm"])
@@ -631,7 +684,7 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, *,
 
     body = _remat(layer_body, cfg.remat)
     (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
-                           params["layers"])
+                           params["layers"], unroll=cfg.scan_unroll)
     h = _rmsnorm(h, params["final_norm"])
     if return_hidden:
         return h, aux
@@ -649,6 +702,192 @@ def _layer_kv(x, lp, rope):
     return _rope(k, rope), v
 
 
+def _cached_attend(q, keys, vals, mask, scale):
+    """Decode-path attention against a KV cache, GQA-grouped.
+
+    q [B,Sq,H,Dh]; keys/vals [B,T,KV,Dh]; mask [Sq,T] bool.  The q heads
+    are reshaped [KV, rep] and contracted against the grouped cache
+    directly — the cache is never expanded to H heads (the repeat would
+    rep x the dominant HBM traffic of decoding, which is exactly reading
+    the cache)."""
+    B, Sq, H, Dh = q.shape
+    KV = keys.shape[2]
+    rep = H // KV
+    qg = q.reshape(B, Sq, KV, rep, Dh)
+    s = jnp.einsum("bqgrd,bkgd->bgrqk", qg, keys
+                   ).astype(jnp.float32) * scale
+    s = jnp.where(mask[None, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bgrqk,bkgd->bqgrd", p.astype(vals.dtype), vals)
+    return o.reshape(B, Sq, H, Dh)
+
+
+def _pick_token(logits, step_key, temperature, dtype):
+    """Greedy or temperature sampling from [B, V] fp32 logits."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(dtype)
+    return jax.random.categorical(
+        step_key, logits / temperature, axis=-1).astype(dtype)
+
+
+def _generate_pp(params: dict, prompt: jax.Array, cfg: LlamaConfig,
+                 mesh: Mesh, max_new_tokens: int, temperature: float,
+                 key: jax.Array) -> jax.Array:
+    """generate() on pp meshes: the layer stack stays stage-RESIDENT
+    (never gathered across pp) and the KV cache lives sharded
+    [L/pp, B/(dp·fsdp), T, KV/tp, Dh] per rank.
+
+    Prefill and each decode tick run one fully-manual shard_map over the
+    whole mesh: the activation visits stages sequentially (python loop
+    over pp with ``lax.cond`` so only the active stage computes, then a
+    ``ppermute`` handoff — single-microbatch decoding cannot hide the
+    pipeline bubble, so the schedule is a plain chain), with Megatron tp
+    psums and per-layer fsdp weight gathers inside the stage exactly as
+    in the training region (:func:`_pp_machinery`).  Embedding, loss
+    head and sampling run OUTSIDE the region under automatic GSPMD, as
+    in the 1F1B step.  MoE decode stays out of scope (ep is an expert-
+    dispatch training axis; rejected in :func:`generate`)."""
+    B, Plen = prompt.shape
+    T = Plen + max_new_tokens
+    pp = mesh.shape["pp"]
+    tp = mesh.shape.get("tp", 1)
+    L, D, H, KV, Dh = (cfg.n_layers, cfg.d_model, cfg.n_heads,
+                       cfg.n_kv_heads, cfg.head_dim)
+    dpf = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    if cfg.n_layers % pp:
+        raise ValueError(f"pp={pp} must divide n_layers={L}")
+    if H % tp or KV % tp:
+        raise ValueError(f"tp={tp} must divide n_heads={H} and "
+                         f"n_kv_heads={KV}")
+    if B % dpf:
+        raise ValueError(f"batch {B} must divide over dp*fsdp = {dpf}")
+    scale = 1.0 / np.sqrt(Dh)
+    dims = param_logical_dims(cfg)
+    layer_dims = {k: d[1:] for k, d in dims["layers"].items()}
+    layer_specs = jax.tree.map(lambda d: shd.spec_for(d), dims["layers"],
+                               is_leaf=lambda x: isinstance(x, tuple))
+    cache_spec = P("pp", ("dp", "fsdp"), None, "tp", None)
+    act_spec = P(("dp", "fsdp"), None, None)
+    perm = [(i, i + 1) for i in range(pp - 1)]
+
+    def gather_layer(lp):
+        out = {}
+        for k2, leaf in lp.items():
+            for i, dname in enumerate(layer_dims[k2]):
+                if dname == "embed":
+                    leaf = lax.all_gather(leaf, "fsdp", axis=i, tiled=True)
+            out[k2] = leaf
+        return out
+
+    def make_stage(rope, mask, write, attend_cache):
+        def layer_step(h, inputs):
+            lp, ck, cv = inputs
+            lp = gather_layer(lp)
+            x = _rmsnorm(h, lp["attn_norm"])
+            q = _rope(jnp.einsum("bsd,dhk->bshk", x, lp["wq"]), rope)
+            k1, v1 = _layer_kv(x, lp, rope)
+            ck = write(ck, k1)
+            cv = write(cv, v1)
+            if attend_cache:                       # decode: q vs cache
+                attn = _cached_attend(q, ck, cv, mask, scale)
+            else:   # prefill: attend over the Plen prompt keys only —
+                # scoring the zero-padded T-length cache would pay
+                # T/Plen x the prefill attention FLOPs on masked slots
+                # (same reasoning as the non-pp prefill_layer).
+                attn = _cached_attend(q, k1, v1, mask, scale)
+            h = h + lax.psum(
+                jnp.einsum("bshk,hkd->bsd", attn, lp["wo"]), "tp")
+            h = h + lax.psum(
+                _dense_mlp(_rmsnorm(h, lp["mlp_norm"]), lp), "tp")
+            return h, (ck, cv)
+
+        def stage(h, layers_loc, ck_loc, cv_loc):
+            h2, (ck2, cv2) = lax.scan(
+                lambda c, i: layer_step(c, i), h,
+                (layers_loc, ck_loc, cv_loc))
+            return h2, ck2, cv2
+
+        return stage
+
+    def pp_chain(stage, h, layers_loc, ck_loc, cv_loc):
+        idx = lax.axis_index("pp")
+        ck, cv = ck_loc, cv_loc
+        for s_ in range(pp):
+            h, ck, cv = lax.cond(
+                idx == s_,
+                lambda op: stage(op[0], op[1], op[2], op[3]),
+                lambda op: (op[0], op[2], op[3]),
+                (h, layers_loc, ck, cv))
+            if s_ < pp - 1:
+                h = lax.ppermute(h, "pp", perm)
+        # Replicate the last stage's output over pp (out_specs say so).
+        return lax.psum(
+            jnp.where(idx == pp - 1, h, jnp.zeros_like(h)), "pp"), ck, cv
+
+    def prefill_local(layers_loc, h_loc):
+        B_loc = h_loc.shape[0]
+        L_loc = jax.tree.leaves(layers_loc)[0].shape[0]
+        positions = jnp.broadcast_to(jnp.arange(Plen), (B_loc, Plen))
+        rope = _rope_tables(positions, cfg.rope_theta, Dh)
+        mask = jnp.tril(jnp.ones((Plen, Plen), bool))
+        write = lambda c, new: lax.dynamic_update_slice(
+            c, new, (0, 0, 0, 0))
+        ck0 = jnp.zeros((L_loc, B_loc, T, KV // tp, Dh), cfg.dtype)
+        stage = make_stage(rope, mask, write, attend_cache=False)
+        return pp_chain(stage, h_loc, layers_loc, ck0, ck0)
+
+    def decode_local(layers_loc, ck_loc, cv_loc, h_loc, pos):
+        B_loc = h_loc.shape[0]
+        rope = _rope_tables(
+            jnp.broadcast_to(pos[None, None], (B_loc, 1)),
+            cfg.rope_theta, Dh)
+        mask = (jnp.arange(T) <= pos)[None, :]                   # [1, T]
+        write = lambda c, new: lax.dynamic_update_slice(
+            c, new, (0, pos, 0, 0))
+        stage = make_stage(rope, mask, write, attend_cache=True)
+        return pp_chain(stage, h_loc, layers_loc, ck_loc, cv_loc)
+
+    def head_logits(h_last):
+        h2 = _rmsnorm(h_last, params["final_norm"])
+        logits = jnp.einsum("bd,dv->bv", h2, params["lm_head"]
+                            ).astype(jnp.float32)
+        return shd.constrain(logits, ("batch", "vocab"), mesh)
+
+    # ---- prefill ------------------------------------------------------
+    h = _embed_lookup(params["embed"], prompt, cfg.dtype)
+    h = shd.constrain(h, ("batch", None, None), mesh)
+    fn = shard_map(prefill_local, mesh=mesh,
+                   in_specs=(layer_specs, act_spec),
+                   out_specs=(act_spec, cache_spec, cache_spec),
+                   check_vma=False)
+    h, cache_k, cache_v = fn(params["layers"], h)
+    key, k0 = jax.random.split(key)
+    first_new = _pick_token(head_logits(h[:, -1]), k0, temperature,
+                            prompt.dtype)
+
+    # ---- decode -------------------------------------------------------
+    def decode_step(carry, step_key):
+        ck, cv, tok, pos = carry
+        h = _embed_lookup(params["embed"], tok[:, None], cfg.dtype)
+        h = shd.constrain(h, ("batch", None, None), mesh)
+        fn = shard_map(decode_local, mesh=mesh,
+                       in_specs=(layer_specs, cache_spec, cache_spec,
+                                 act_spec, P()),
+                       out_specs=(act_spec, cache_spec, cache_spec),
+                       check_vma=False)
+        h, ck, cv = fn(params["layers"], ck, cv, h, pos)
+        nxt = _pick_token(head_logits(h[:, 0]), step_key, temperature,
+                          prompt.dtype)
+        return (ck, cv, nxt, pos + 1), nxt
+
+    carry0 = (cache_k, cache_v, first_new, jnp.asarray(Plen, jnp.int32))
+    _, toks = lax.scan(decode_step, carry0,
+                       jax.random.split(key, max_new_tokens - 1))
+    new_toks = jnp.concatenate([first_new[:, None], toks.swapaxes(0, 1)],
+                               axis=1)
+    return jnp.concatenate([prompt, new_toks], axis=1)
+
+
 def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig, *,
              max_new_tokens: int, mesh: Optional[Mesh] = None,
              temperature: float = 0.0,
@@ -662,39 +901,43 @@ def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig, *,
     stack once over the prompt (causal, batched — MXU-shaped); decode is a
     ``lax.scan`` over new tokens, each step attending to the cache and
     appending its own K/V (O(T·L·cache) instead of re-running the full
-    forward per token).  Works pure (mesh=None) or under GSPMD meshes
-    whose axes are automatic (dp/fsdp/tp); the manual-collective axes
-    (pp/sp/ep) need the training paths and are rejected here.
+    forward per token).  Works pure (mesh=None), under GSPMD meshes whose
+    axes are automatic (dp/fsdp/tp — the KV cache is constrained to
+    [batch over dp·fsdp, kv_heads over tp], never replicated), or on pp
+    meshes via the stage-resident manual path (:func:`_generate_pp`).
+    sp/ep stay training-path axes and MoE decode is out of scope
+    (expert dispatch is built for training token volumes; rejected
+    explicitly).
     """
-    if mesh is not None and any(
-            mesh.shape.get(a, 1) > 1 for a in ("pp", "sp", "ep")):
-        raise NotImplementedError(
-            "generate supports dp/fsdp/tp meshes; pp/sp/ep are "
-            "training-path axes")
     if cfg.use_moe:
         raise NotImplementedError("generate does not support MoE configs")
-    B, P = prompt.shape
-    T = P + max_new_tokens
+    if mesh is not None and any(
+            mesh.shape.get(a, 1) > 1 for a in ("sp", "ep")):
+        raise NotImplementedError(
+            "generate supports dp/fsdp/tp/pp meshes; sp/ep are "
+            "training-path axes")
     if temperature > 0.0 and key is None:
         raise ValueError("temperature > 0 requires a PRNG key")
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got "
                          f"{max_new_tokens}")
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused when greedy
+    if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        return _generate_pp(params, prompt, cfg, mesh, max_new_tokens,
+                            temperature, key)
+    B, P = prompt.shape
+    T = P + max_new_tokens
     KV, Dh = cfg.n_kv_heads, cfg.head_dim
-    rep = cfg.n_heads // cfg.n_kv_heads
     scale = 1.0 / np.sqrt(Dh)
-    L = cfg.n_layers
 
-    def attend(q, keys, vals, mask):
-        # q [B,Sq,H,Dh]; keys/vals [B,T,KV,Dh]; mask [Sq,T] bool.
-        if rep != 1:
-            keys = jnp.repeat(keys, rep, axis=2)
-            vals = jnp.repeat(vals, rep, axis=2)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, keys
-                       ).astype(jnp.float32) * scale
-        s = jnp.where(mask[None, None], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(vals.dtype), vals)
+    def constrain_cache(c):
+        # Heads over tp, batch over dp/fsdp: without the annotation the
+        # propagator happily replicates the cache — the largest live
+        # tensor of the whole decode — on every tp rank.
+        if mesh is None:
+            return c
+        return shd.constrain(c, ("batch", None, "kv_heads", None), mesh)
 
     # ---- prefill: build the cache over the prompt ----------------------
     h = _embed_lookup(params["embed"], prompt, cfg.dtype)
@@ -709,27 +952,21 @@ def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig, *,
         # Attention over the P prompt keys only; the T-length cache is
         # written separately (attending into the zero-padded cache would
         # pay T/P times the prefill score FLOPs on masked positions).
-        attn = attend(q, k, v, prefill_mask)
-        ck = jnp.zeros((B, T, KV, Dh), cfg.dtype).at[:, :P].set(k)
-        cv = jnp.zeros((B, T, KV, Dh), cfg.dtype).at[:, :P].set(v)
+        attn = _cached_attend(q, k, v, prefill_mask, scale)
+        ck = constrain_cache(
+            jnp.zeros((B, T, KV, Dh), cfg.dtype).at[:, :P].set(k))
+        cv = constrain_cache(
+            jnp.zeros((B, T, KV, Dh), cfg.dtype).at[:, :P].set(v))
         h = h + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
         h = h + _dense_mlp(_rmsnorm(h, lp["mlp_norm"]), lp)
         return h, (ck, cv)
 
     h, (cache_k, cache_v) = lax.scan(prefill_layer, h, params["layers"])
-    def pick(logits, k):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        return jax.random.categorical(
-            k, logits / temperature, axis=-1).astype(prompt.dtype)
-
-    if key is None:
-        key = jax.random.PRNGKey(0)  # unused when greedy
     key, k0 = jax.random.split(key)
     logits = jnp.einsum("bd,dv->bv",
                         _rmsnorm(h[:, -1], params["final_norm"]),
                         params["lm_head"]).astype(jnp.float32)
-    first_new = pick(logits, k0)                                  # [B]
+    first_new = _pick_token(logits, k0, temperature, prompt.dtype)  # [B]
 
     # ---- decode: one token per tick, cache append ----------------------
     def decode_step(carry, step_key):
@@ -745,9 +982,11 @@ def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig, *,
             x = _rmsnorm(h, lp["attn_norm"])
             q = _rope(jnp.einsum("bsd,dhk->bshk", x, lp["wq"]), rope_1)
             k1, v1 = _layer_kv(x, lp, rope_1)
-            ck = lax.dynamic_update_slice(ck, k1, (0, pos, 0, 0))
-            cv = lax.dynamic_update_slice(cv, v1, (0, pos, 0, 0))
-            attn = attend(q, ck, cv, mask)
+            ck = constrain_cache(
+                lax.dynamic_update_slice(ck, k1, (0, pos, 0, 0)))
+            cv = constrain_cache(
+                lax.dynamic_update_slice(cv, v1, (0, pos, 0, 0)))
+            attn = _cached_attend(q, ck, cv, mask, scale)
             h = h + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
             h = h + _dense_mlp(_rmsnorm(h, lp["mlp_norm"]), lp)
             return h, (ck, cv)
@@ -757,7 +996,7 @@ def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig, *,
         logits = jnp.einsum("bd,dv->bv",
                             _rmsnorm(h[:, 0], params["final_norm"]),
                             params["lm_head"]).astype(jnp.float32)
-        nxt = pick(logits, step_key)
+        nxt = _pick_token(logits, step_key, temperature, prompt.dtype)
         return (cache_k, cache_v, nxt, pos + 1), nxt
 
     # max_new_tokens - 1 decode steps: the first new token came from the

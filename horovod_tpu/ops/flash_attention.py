@@ -39,7 +39,13 @@ _NEG_INF = -1e30
 
 
 def dense_attention(q, k, v, scale, causal):
-    """Dense XLA attention — the fallback path and the test oracle."""
+    """Dense XLA attention — the fallback path and the test oracle.
+    Accepts grouped K/V (kv_heads dividing q heads): expands by repeat,
+    which is exactly the HBM cost the GQA-native kernel path avoids."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         S = q.shape[1]
@@ -114,25 +120,35 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
                                   (8, lse_ref.shape[-1]))
 
 
+def _kv_row_map(H: int, KV: int):
+    """BlockSpec index map sending a flattened q-head row ``b*H + h`` to
+    its kv row ``b*KV + h // rep`` — the GQA-native indexing: K/V stay
+    [B*KV, S, D] in HBM (rep x smaller than the ``jnp.repeat`` expansion)
+    and adjacent q-head programs of one group hit the SAME kv block, so
+    Pallas skips the re-fetch between consecutive grid steps."""
+    rep = H // KV
+    return lambda bh, qi: ((bh // H) * KV + (bh % H) // rep, 0, 0)
+
+
 def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, S, H, D = q.shape
+    KV = k.shape[2]
     qt, kt, vt = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
     kernel = functools.partial(
         _fwd_kernel, block_q=block_q, block_k=block_k, seq_len=S,
         scale=scale, causal=causal)
+    kv_map = _kv_row_map(H, KV)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, S // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, D), kv_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, D), kv_map, memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0),
@@ -193,14 +209,23 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, block_q: int, block_k: int,
-                    seq_len: int, scale: float, causal: bool):
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                    block_k: int, seq_len: int, scale: float, causal: bool,
+                    rep: int):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(1)
+    r = pl.program_id(2)      # q head within this kv group (innermost dim:
+    # the dk/dv output block index ignores r, so the accumulators stay
+    # VMEM-resident across the whole group)
     k = k_ref[0]                                      # [BK, D] input dtype
     v = v_ref[0]
     n_q = seq_len // block_q
+
+    @pl.when(r == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
     def body(qi, carry):
         dk, dv = carry
@@ -229,10 +254,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lower = (ki * block_k) // block_q             # first unmasked q block
     else:
         lower = 0
-    zeros = jnp.zeros((block_k, k_ref.shape[-1]), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lower, n_q, body, (zeros, zeros))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk, dv = jax.lax.fori_loop(lower, n_q, body, (dk_acc[...], dv_acc[...]))
+    dk_acc[...] = dk
+    dv_acc[...] = dv
+
+    @pl.when(r == rep - 1)
+    def _flush():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_backward(q, k, v, out, lse, g, *, scale, causal, block_q,
@@ -241,6 +270,8 @@ def _flash_backward(q, k, v, out, lse, g, *, scale, causal, block_q,
     from jax.experimental.pallas import tpu as pltpu
 
     B, S, H, D = q.shape
+    KV = k.shape[2]
+    rep = H // KV
     qt, kt, vt = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
     dot = _to_bhsd(g)
     # delta_i = rowsum(dO * O): cheap elementwise, done outside the kernels.
@@ -251,7 +282,7 @@ def _flash_backward(q, k, v, out, lse, g, *, scale, causal, block_q,
     delta3 = jnp.broadcast_to(delta[:, None, :], (BH, 8, S))
 
     common_in = [qt, kt, vt, dot, lse3, delta3]
-    full = lambda bh, i: (bh, 0, 0)
+    kv_map = _kv_row_map(H, KV)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
@@ -260,8 +291,8 @@ def _flash_backward(q, k, v, out, lse, g, *, scale, causal, block_q,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, D), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, D), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, D), kv_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, D), kv_map, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 8, block_q), lambda bh, qi: (bh, 0, qi),
@@ -275,35 +306,45 @@ def _flash_backward(q, k, v, out, lse, g, *, scale, causal, block_q,
         interpret=interpret,
     )(*common_in)
 
+    # dk/dv: one program per (kv row, k block, q-head-in-group), r
+    # innermost so the fp32 scratch accumulators survive the whole group
+    # in VMEM and flush once — exact fp32 accumulation over the rep q
+    # heads without rep x VMEM for Q/dO (each r step re-indexes the
+    # [1, S, D] Q/dO blocks instead of widening them).
+    grp = lambda kb, ki, r: (kb * rep + r, 0, 0)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
-                          seq_len=S, scale=scale, causal=causal),
-        grid=(B * H, S // block_k),
+                          seq_len=S, scale=scale, causal=causal, rep=rep),
+        grid=(B * KV, S // block_k, rep),
         in_specs=[
-            pl.BlockSpec((1, S, D), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0),
+            pl.BlockSpec((1, S, D), grp, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda kb, ki, r: (kb, ki, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0),
+            pl.BlockSpec((1, block_k, D), lambda kb, ki, r: (kb, ki, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, D), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 8, S), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 8, S), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, D), grp, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, S), grp, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, S), grp, memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0),
+            pl.BlockSpec((1, block_k, D), lambda kb, ki, r: (kb, ki, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0),
+            pl.BlockSpec((1, block_k, D), lambda kb, ki, r: (kb, ki, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
+            jax.ShapeDtypeStruct((B * KV, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B * KV, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
     )(*common_in)
 
-    return (_from_bhsd(dq, B, H), _from_bhsd(dk, B, H),
-            _from_bhsd(dv, B, H))
+    return (_from_bhsd(dq, B, H), _from_bhsd(dk, B, KV),
+            _from_bhsd(dv, B, KV))
 
 
 # ---------------------------------------------------------------------------
@@ -353,7 +394,12 @@ def supported(q_shape: tuple, itemsize: int = 4) -> bool:
 def flash_attention(q, k, v, scale: Optional[float] = None,
                     causal: bool = True, block_q: Optional[int] = None,
                     block_k: Optional[int] = None, interpret: bool = False):
-    """Exact attention, flash-style.  q/k/v: [B, S, H, D] → [B, S, H, D]."""
+    """Exact attention, flash-style.  q: [B, S, H, D] → [B, S, H, D].
+
+    GQA-native: k/v may carry ``KV = H / rep`` heads ([B, S, KV, D]) and
+    are indexed per-group inside the kernels — K/V HBM arrays, traffic
+    and dk/dv outputs all stay ``rep`` x smaller than a
+    ``jnp.repeat``-expanded call (round-4 verdict ask #1a)."""
     out, _ = _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret)
     return out
 
@@ -366,6 +412,10 @@ def _resolve(q, scale, block_q, block_k):
 
 
 def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
+    if q.shape[2] % k.shape[2] or k.shape[2] != v.shape[2]:
+        raise ValueError(
+            f"kv heads {k.shape[2]}/{v.shape[2]} must be equal and divide "
+            f"q heads {q.shape[2]}")
     scale, bq, bk = _resolve(q, scale, block_q, block_k)
     return _flash_forward(q, k, v, scale=scale, causal=causal, block_q=bq,
                           block_k=bk, interpret=interpret)

@@ -281,8 +281,68 @@ def test_store_create_flavors(tmp_path):
     assert isinstance(st, FilesystemStore)
     ck = st.checkpoint_path("run1")
     assert os.path.isdir(ck) and "runs/run1" in ck.replace(os.sep, "/")
-    with pytest.raises(ValueError, match="mount"):
+    with pytest.raises(ValueError, match="mount.*register|register"):
         Store.create("gs://bucket/prefix")
+
+
+def test_store_register_resolves_scheme():
+    from horovod_tpu.estimator import InMemoryObjectStore, Store
+    # Plug a client for a scheme (the † HDFSStore/S3Store seam); create()
+    # then resolves URIs of that scheme through it instead of erroring.
+    Store.register("fakegs")(InMemoryObjectStore)
+    try:
+        st = Store.create("fakegs://bucket-a/some/prefix")
+        assert isinstance(st, InMemoryObjectStore)
+        st.obj_write("runs/r1/x.bin", b"payload")
+        assert st.obj_exists("runs/r1/x.bin")
+        # A second instance of the same bucket URI sees the same objects
+        # (two hosts, one bucket).
+        st2 = Store.create("fakegs://bucket-a/some/prefix")
+        assert st2.obj_read("runs/r1/x.bin") == b"payload"
+        assert st2.obj_list("runs/r1/") == ["runs/r1/x.bin"]
+    finally:
+        Store._registry.pop("fakegs", None)
+
+
+def test_remote_store_stage_sync_fetch_roundtrip():
+    from horovod_tpu.estimator import InMemoryObjectStore
+    st = InMemoryObjectStore("fake://bkt-rt/pfx")
+    ck = st.checkpoint_path("r7")          # local staging dir
+    assert os.path.isdir(ck) and "runs/r7" in ck.replace(os.sep, "/")
+    with open(os.path.join(ck, "weights.bin"), "wb") as f:
+        f.write(b"\x01\x02")
+    with open(os.path.join(st.logs_path("r7"), "log.txt"), "w") as f:
+        f.write("hello")
+    st.sync("r7")
+    assert st.obj_exists("runs/r7/checkpoints/weights.bin")
+    # fetch() pulls the run tree back down preserving relative paths —
+    # the transform-on-another-host path.
+    other = InMemoryObjectStore("fake://bkt-rt/pfx")
+    root = other.fetch("r7")
+    with open(os.path.join(root, "checkpoints", "weights.bin"), "rb") as f:
+        assert f.read() == b"\x01\x02"
+    with open(os.path.join(root, "logs", "log.txt")) as f:
+        assert f.read() == "hello"
+
+
+@pytest.mark.integration
+def test_jax_estimator_fit_against_remote_store():
+    # End-to-end: fit with a RemoteStore — per-epoch orbax checkpoints
+    # stage locally and sync() publishes them as objects (round-4 verdict
+    # ask #7: estimator fit/transform against the fake remote store).
+    from horovod_tpu.estimator import InMemoryObjectStore
+    import optax
+    store = InMemoryObjectStore("fake://bkt-fit/artifacts")
+    df = _regression_frame()
+    est = JaxEstimator(model=_Linear(), feature_cols=["features"],
+                       label_cols=["label"], loss="mse", batch_size=64,
+                       epochs=3, seed=0, optimizer=optax.adam(0.1),
+                       store=store, run_id="remote-run")
+    fitted = est.fit(df)
+    objs = store.obj_list("runs/remote-run/")
+    assert any("checkpoints" in k for k in objs), objs
+    out = fitted.transform(df)
+    assert "prediction" in out.columns
 
 
 @pytest.mark.integration

