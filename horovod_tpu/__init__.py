@@ -63,7 +63,11 @@ from .ops import (  # noqa: F401
     per_rank_from_fn,
     to_numpy,
 )
-from .ops.collectives import from_local, to_local  # noqa: F401
+from .ops.collectives import (  # noqa: F401
+    from_local,
+    replicate_local,
+    to_local,
+)
 from .ops.engine import Handle, HorovodInternalError, TensorTableEntry
 from .ops import collectives as _C
 

@@ -168,11 +168,14 @@ class RemoteStore(Store):
                 self._uploaded[rel] = sig
 
     def fetch(self, run_id: str, dest: Optional[str] = None) -> str:
-        """Download every object of ``run_id`` under ``dest`` (default: a
-        fresh staging dir) preserving relative paths; returns the local
-        run root."""
+        """Download every object of ``run_id`` under ``dest`` preserving
+        relative paths; returns the local run root.  The default dest is
+        a fresh mkdtemp OWNED BY THE CALLER — deliberately not inside
+        this store's staging dir, whose finalizer removes it when the
+        store is collected (fetch is the transform-on-another-host path:
+        the fetched tree must outlive the store handle)."""
         prefix = self._run_key(run_id) + "/"
-        dest = dest or os.path.join(self._staging, "fetched", run_id)
+        dest = dest or tempfile.mkdtemp(prefix=f"hvdtpu-fetch-{run_id}-")
         for key in self.obj_list(prefix):
             rel = key[len(prefix):]
             local = os.path.join(dest, rel)

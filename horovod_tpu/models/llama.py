@@ -281,16 +281,8 @@ def _embed_lookup(embed: jax.Array, tokens: jax.Array, dtype) -> jax.Array:
     return jnp.einsum("bsv,vd->bsd", onehot, embed.astype(dtype))
 
 
-def _gqa_expand(q, k, v):
-    """Materialize grouped K/V up to q's head count — only for attention
-    paths without native GQA indexing (dense oracle, ring/Ulysses sp);
-    the Pallas flash kernels index kv heads directly and never pay this
-    rep x HBM expansion."""
-    if k.shape[2] != q.shape[2]:
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    return k, v
+# One canonical expansion helper (shared with the dense oracle).
+from ..ops.flash_attention import gqa_expand as _gqa_expand  # noqa: E402
 
 
 def _attn_block(h, lp, rope, cfg: LlamaConfig, attention):
@@ -361,8 +353,14 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool,
             dpf = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
             tp = mesh.shape.get("tp", 1)
             local = (B // max(dpf, 1), S, H // max(tp, 1), D)
-            if (B % dpf == 0 and H % tp == 0 and KV % tp == 0
+            if (B % dpf == 0 and H % tp == 0
                     and FA.supported(local, q.dtype.itemsize)):
+                if KV % tp:
+                    # tp divides H but not KV: the grouped cache cannot
+                    # shard over tp — expand K/V and keep the flash
+                    # kernel (losing it entirely would be a 2-5x
+                    # regression for the sake of the GQA memory win).
+                    k, v = _gqa_expand(q, k, v)
                 spec = P(("dp", "fsdp"), None, "tp", None)
                 fn = shard_map(
                     lambda q_, k_, v_: FA.flash_attention(

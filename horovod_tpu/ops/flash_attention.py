@@ -38,14 +38,27 @@ import numpy as np
 _NEG_INF = -1e30
 
 
-def dense_attention(q, k, v, scale, causal):
-    """Dense XLA attention — the fallback path and the test oracle.
-    Accepts grouped K/V (kv_heads dividing q heads): expands by repeat,
-    which is exactly the HBM cost the GQA-native kernel path avoids."""
-    if k.shape[2] != q.shape[2]:
-        rep = q.shape[2] // k.shape[2]
+def gqa_expand(q, k, v):
+    """Materialize grouped K/V up to q's head count — for attention paths
+    without native GQA indexing (the dense oracle, ring/Ulysses sp, and
+    flash on meshes where tp divides H but not KV); the Pallas kernels
+    index kv heads directly and never pay this rep x HBM expansion."""
+    H, KV = q.shape[2], k.shape[2]
+    if KV != H:
+        if H % KV:
+            raise ValueError(
+                f"kv heads {KV} must divide q heads {H}")
+        rep = H // KV
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+def dense_attention(q, k, v, scale, causal):
+    """Dense XLA attention — the fallback path and the test oracle.
+    Accepts grouped K/V (kv_heads dividing q heads) via
+    :func:`gqa_expand`."""
+    k, v = gqa_expand(q, k, v)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         S = q.shape[1]

@@ -228,6 +228,36 @@ def test_flash_model_path_matches_dense_on_mesh():
             rtol=2e-3, atol=2e-4, err_msg=key)
 
 
+def test_flash_kept_when_tp_exceeds_kv_heads():
+    """GQA config where tp divides H but NOT KV (n_kv_heads=2, tp=4):
+    the flash path must survive by expanding K/V (round-5 review: the
+    grouped-KV dispatch silently dropped to dense here, a 2-5x
+    regression), and the result must match the dense oracle."""
+    from horovod_tpu.models import llama as L
+
+    mesh = build_mesh(MeshConfig(dp=2, tp=4))
+    cfg = llama.LlamaConfig.tiny(
+        n_layers=2, d_model=256, n_heads=4, n_kv_heads=2,
+        d_ff=256, vocab_size=128)
+    params = llama.init_params(cfg, jax.random.PRNGKey(1), mesh)
+    tokens = np.random.RandomState(2).randint(
+        0, cfg.vocab_size, size=(8, 257))
+    batch = jax.device_put(
+        {"tokens": jnp.asarray(tokens, jnp.int32)},
+        NamedSharding(mesh, P(("dp", "fsdp"))))
+
+    def loss_of(force_flash):
+        old = L._FORCE_FLASH_INTERPRET
+        L._FORCE_FLASH_INTERPRET = force_flash
+        try:
+            return float(jax.jit(
+                lambda p: llama.loss_fn(p, batch, cfg, mesh=mesh))(params))
+        finally:
+            L._FORCE_FLASH_INTERPRET = old
+
+    np.testing.assert_allclose(loss_of(True), loss_of(False), rtol=1e-5)
+
+
 def test_pp_sp_matches_dp_oracle():
     """pp×sp composition: ring attention inside the fully-manual pipeline
     region must be loss-equivalent to plain DP (round-3 verdict gap —
