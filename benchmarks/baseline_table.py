@@ -43,7 +43,7 @@ def _day(rec: dict) -> str:
     ts = rec.get("ts")
     if not ts:
         return "—"
-    return datetime.datetime.fromtimestamp(ts, datetime.UTC).strftime(
+    return datetime.datetime.fromtimestamp(ts, datetime.timezone.utc).strftime(
         "%Y-%m-%d")
 
 

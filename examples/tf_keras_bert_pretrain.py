@@ -15,8 +15,16 @@ Run:  hvdrun -np 2 python examples/tf_keras_bert_pretrain.py
 """
 
 import argparse
+import os
 
 import numpy as np
+
+# One XLA device per worker process: a parent test rig's XLA_FLAGS
+# (--xla_force_host_platform_device_count=8) leaks into subprocess
+# workers, giving each 8 virtual ranks and crashing gloo with mismatched
+# op sizes — re-append =1 (last flag wins) before jax initializes.
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=1"
 
 import horovod_tpu.tensorflow.keras as hvd
 

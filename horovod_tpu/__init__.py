@@ -446,10 +446,13 @@ def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
     if not state.initialized:
         raise NotInitializedError()
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        src = _root_process_of_rank(root_rank) == jax.process_index()
-        params = multihost_utils.broadcast_one_to_all(
-            jax.tree.map(np.asarray, params), is_source=src)
+        # Per-leaf negotiated broadcast verb, NOT
+        # multihost_utils.broadcast_one_to_all — the latter silently
+        # returns local zeros on the CPU-gloo rig (jax 0.4.x).
+        params = jax.tree.map(
+            lambda a: _C.to_numpy(broadcast(
+                _C.replicate_local(np.asarray(a)), root_rank)),
+            params)
     sharding = NamedSharding(state.mesh, P())
     return jax.tree.map(
         lambda a: jax.device_put(np.asarray(a), sharding), params)
@@ -460,20 +463,26 @@ def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
     († ``hvd.broadcast_object``).
 
     Multi-process: two-phase broadcast (length, then padded pickle buffer)
-    through the coordination service, since buffer shapes must agree on every
-    host; non-source hosts contribute zero-filled placeholders.
+    riding the negotiated broadcast verb, since buffer shapes must agree on
+    every host; non-source hosts contribute zero-filled placeholders.
+    (``multihost_utils.broadcast_one_to_all`` is deliberately not used: it
+    silently returns local zeros on the CPU-gloo rig, jax 0.4.x.)
     """
     import jax
     if jax.process_count() > 1:
         import pickle
         import numpy as np
-        from jax.experimental import multihost_utils
         src = _root_process_of_rank(root_rank) == jax.process_index()
-        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-        length = int(multihost_utils.broadcast_one_to_all(
-            np.int64(payload.size), is_source=src))
-        buf = payload if src else np.zeros((length,), np.uint8)
-        buf = multihost_utils.broadcast_one_to_all(buf, is_source=src)
+        payload = (np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+                   if src else np.zeros((0,), np.uint8))
+        length = int(np.asarray(_C.to_numpy(broadcast(
+            _C.replicate_local(np.zeros((1,), np.int64) + payload.size),
+            root_rank)))[0])
+        buf = np.zeros((length,), np.uint8)
+        if src:
+            buf[:] = payload
+        buf = np.asarray(_C.to_numpy(broadcast(
+            _C.replicate_local(buf), root_rank)))
         return pickle.loads(bytes(buf))
     return obj
 

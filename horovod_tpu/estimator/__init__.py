@@ -265,7 +265,12 @@ class JaxEstimator:
     def _epoch_end(self, entry: dict, epoch: int, params) -> None:
         if self.verbose:
             print(f"[JaxEstimator] {entry}")
-        if self.store is not None:
+        # rank 0 only, like the Keras path: concurrent writers on a shared
+        # store corrupt the checkpoint († checkpoint on rank 0).
+        import horovod_tpu as hvd
+        rank0 = not (hvd.is_initialized() and hvd.size() > 1) \
+            or hvd.cross_rank() == 0
+        if self.store is not None and rank0:
             from ..utils.checkpoint import Checkpointer
             Checkpointer(self.store.checkpoint_path(self.run_id)) \
                 .save(epoch, {"params": params})

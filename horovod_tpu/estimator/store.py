@@ -126,10 +126,16 @@ class RemoteStore(Store):
         import weakref
         self._cleanup = weakref.finalize(
             self, shutil.rmtree, self._staging, ignore_errors=True)
-        #: rel-path -> (size, mtime) already uploaded; sync() skips
-        #: unchanged files so per-epoch syncs stay O(new files), not
-        #: O(run history) per call.
-        self._uploaded: dict[str, tuple[int, float]] = {}
+        #: rel-path -> ((size, mtime_ns), content sha256) already
+        #: uploaded; sync() skips unchanged files so per-epoch syncs stay
+        #: O(new/changed files), not O(run history) per call.  Small
+        #: files are ALWAYS re-hashed (a same-size in-place rewrite
+        #: within the filesystem's mtime granularity must not be silently
+        #: skipped — cheap at small sizes); large files trust the
+        #: nanosecond-mtime stat gate (a multi-MB rewrite landing within
+        #: one mtime_ns tick is not a real write pattern), and a changed
+        #: stat still dedups on content hash before re-uploading.
+        self._uploaded: dict[str, tuple[tuple, str]] = {}
 
     # -- object primitives (subclass contract) ---------------------------
     def obj_read(self, key: str) -> bytes:
@@ -151,21 +157,33 @@ class RemoteStore(Store):
     def _run_key(self, run_id: str) -> str:
         return f"runs/{run_id}"
 
+    #: below this size a file is re-hashed every sync instead of trusting
+    #: its stat signature (see the _uploaded comment above)
+    _STAT_TRUST_BYTES = 1 << 20
+
     def sync(self, run_id: str) -> None:
+        import hashlib
         root = self.run_path(run_id)
         for dirpath, _, files in os.walk(root):
             for f in files:
                 local = os.path.join(dirpath, f)
                 rel = os.path.join(run_id, os.path.relpath(local, root))
                 st = os.stat(local)
-                sig = (st.st_size, st.st_mtime)
-                if self._uploaded.get(rel) == sig:
-                    continue          # already published, unchanged
+                sig = (st.st_size, st.st_mtime_ns)
+                prev = self._uploaded.get(rel)
+                if (prev is not None and prev[0] == sig
+                        and st.st_size > self._STAT_TRUST_BYTES):
+                    continue     # large + stat-identical: trust mtime_ns
                 with open(local, "rb") as fh:
-                    self.obj_write(
-                        f"{self._run_key(run_id)}/"
-                        f"{os.path.relpath(local, root)}", fh.read())
-                self._uploaded[rel] = sig
+                    data = fh.read()
+                digest = hashlib.sha256(data).hexdigest()
+                if prev is not None and prev[1] == digest:
+                    self._uploaded[rel] = (sig, digest)
+                    continue     # content unchanged (e.g. touch)
+                self.obj_write(
+                    f"{self._run_key(run_id)}/"
+                    f"{os.path.relpath(local, root)}", data)
+                self._uploaded[rel] = (sig, digest)
 
     def fetch(self, run_id: str, dest: Optional[str] = None) -> str:
         """Download every object of ``run_id`` under ``dest`` preserving
@@ -173,12 +191,22 @@ class RemoteStore(Store):
         a fresh mkdtemp OWNED BY THE CALLER — deliberately not inside
         this store's staging dir, whose finalizer removes it when the
         store is collected (fetch is the transform-on-another-host path:
-        the fetched tree must outlive the store handle)."""
+        the fetched tree must outlive the store handle).
+
+        Object keys are untrusted remote state: any key whose normalized
+        relative path escapes ``dest`` (absolute or ``..`` components) is
+        rejected before a byte is written."""
         prefix = self._run_key(run_id) + "/"
         dest = dest or tempfile.mkdtemp(prefix=f"hvdtpu-fetch-{run_id}-")
+        dest_root = os.path.realpath(dest)
         for key in self.obj_list(prefix):
             rel = key[len(prefix):]
-            local = os.path.join(dest, rel)
+            local = os.path.normpath(os.path.join(dest_root, rel))
+            if os.path.isabs(rel) or local == dest_root or \
+                    not local.startswith(dest_root + os.sep):
+                raise ValueError(
+                    f"refusing to fetch object key {key!r}: its relative "
+                    f"path {rel!r} escapes the destination directory")
             os.makedirs(os.path.dirname(local), exist_ok=True)
             with open(local, "wb") as fh:
                 fh.write(self.obj_read(key))

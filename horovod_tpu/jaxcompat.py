@@ -1,0 +1,57 @@
+"""Version bridge for jax's ``shard_map`` surface.
+
+The codebase targets the current API (``jax.shard_map`` with ``check_vma``
+and ``axis_names``); older jaxlib builds (<= 0.4.x, the pinned rig image)
+ship it as ``jax.experimental.shard_map.shard_map`` with ``check_rep`` and
+an ``auto`` axis set instead.  Every in-repo call site imports from here so
+the translation lives in exactly one place:
+
+- ``check_vma`` -> ``check_rep`` (same meaning: verify per-axis replication
+  of outputs; both default True upstream).
+- ``axis_names={...}`` (the axes the body is MANUAL over) -> ``auto =
+  mesh.axis_names - axis_names`` (the axes left automatic).
+"""
+
+from __future__ import annotations
+
+import jax
+
+_NEW = hasattr(jax, "shard_map")
+
+
+def shard_map(f, mesh, in_specs, out_specs, *, check_vma=None,
+              axis_names=None, **kw):
+    """``jax.shard_map`` with new-API kwargs on any supported jax."""
+    if _NEW:
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped mesh axis, from inside the mapped context.
+
+    New jax exposes ``jax.lax.axis_size``; on 0.4.x the same integer
+    comes back from ``jax.core.axis_frame`` (which, despite the name,
+    returns the bound size of the named axis).
+    """
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    from jax import core
+    return core.axis_frame(axis_name)
+
+
+def leaves_with_path(tree):
+    """``jax.tree.leaves_with_path`` on new jax, ``jax.tree_util`` on old."""
+    if hasattr(jax.tree, "leaves_with_path"):
+        return jax.tree.leaves_with_path(tree)
+    return jax.tree_util.tree_leaves_with_path(tree)

@@ -25,7 +25,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+from ..jaxcompat import axis_size, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -111,7 +112,7 @@ def sharded_embedding_lookup_local(tables: jax.Array, indices: jax.Array, *,
     lookup needs a transpose of the sharding, which is exactly one
     all_to_all each way († DLRM's butterfly shuffle on ``hvd.alltoall``).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     b, T = indices.shape
     t_local = tables.shape[0]
     # [b, T] -> [n, b, T/n]: group index columns by owning device.

@@ -25,7 +25,8 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+from ..jaxcompat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import sharding as shd
@@ -139,9 +140,26 @@ def param_logical_dims(cfg: LlamaConfig) -> dict:
     }
 
 
+def shard_rules(cfg: LlamaConfig, mesh: Optional[Mesh]) -> Optional[dict]:
+    """Mesh-aware logical-rule overrides for this config.
+
+    GQA configs where tp divides ``n_heads`` but not ``n_kv_heads`` (e.g.
+    kv=2 on a tp=4 mesh) degrade the ``kv_heads`` rule to a dividing
+    prefix or replication instead of failing init with an indivisible
+    sharding — the flash path then keeps the kernel by expanding K/V at
+    dispatch (see :func:`_attention`)."""
+    if mesh is None:
+        return None
+    return shd.fitted_rules(mesh, {
+        "heads": cfg.n_heads,
+        "kv_heads": cfg.n_kv_heads,
+    })
+
+
 def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> dict:
+    rules = shard_rules(cfg, mesh)
     return jax.tree.map(
-        lambda dims: shd.logical_sharding(mesh, dims),
+        lambda dims: shd.logical_sharding(mesh, dims, rules),
         param_logical_dims(cfg),
         is_leaf=lambda x: isinstance(x, tuple))
 
@@ -336,13 +354,18 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool,
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
     if sp > 1:
         k, v = _gqa_expand(q, k, v)   # ring/Ulysses rotate full head sets
+        # FULL-manual over every mesh axis (partial-auto shard_map lowers
+        # axis_index to PartitionId on 0.4.x jaxlib and the SPMD
+        # partitioner rejects it): the batch/head dims are explicitly
+        # dp·fsdp / tp sliced instead of left to GSPMD, and the body only
+        # communicates over sp.
+        spec = P(("dp", "fsdp"), "sp", "tp", None)
         fn = shard_map(
             partial(_sp_local_attention(sp_mode), axis_name="sp",
                     causal=causal),
             mesh=mesh,
-            in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
-            out_specs=P(None, "sp"),
-            axis_names={"sp"},
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
             check_vma=False)
         return fn(q, k, v)
     if _flash_backend():
@@ -391,23 +414,49 @@ def _moe_mlp(h2, lp, cfg: LlamaConfig, mesh: Optional[Mesh]):
                "w_down": lp["w_down"]}
     ep = mesh.shape.get("ep", 1) if mesh is not None else 1
     if ep > 1:
-        # Expert buffers lose their token dim when built, so on the axes
-        # that stay automatic inside this shard_map (dp/fsdp/tp) they are
-        # replicated; pin that so the propagator can't smear batch
-        # shardings onto the expert dim of saved-for-backward buffers.
-        repl = NamedSharding(mesh, P())
+        # FULL-manual over every mesh axis (partial-auto shard_map is
+        # rejected by the SPMD partitioner on 0.4.x jaxlib): dp/fsdp/ep
+        # all count as token axes so each ep rank dispatches distinct
+        # local tokens (mirroring the pp path), the expert hidden dim is
+        # Megatron-sliced over tp with an explicit row-parallel psum, and
+        # aux rides out as shape [1] (rank-0 outputs of differentiated
+        # shard_maps trip a spec error on 0.4.x).
+        all_axes = tuple(mesh.axis_names)
+
+        def expert_fn_tp(w, x):
+            g = jax.nn.silu(x @ w["w_gate"])
+            u = x @ w["w_up"]
+            return lax.psum((g * u) @ w["w_down"], "tp")
+
+        def local_moe(tok, rk, pr):
+            out, aux = moe_layer_local(
+                tok, rk, expert_fn_tp, pr, axis_name="ep",
+                capacity_factor=cfg.capacity_factor)
+            # pmean over every axis: data axes average the per-shard aux
+            # into the global mean; replicated axes (tp/pp) are forward
+            # no-ops that keep the transpose psum correctly 1/n-scaled.
+            return out, lax.pmean(aux, all_axes).reshape(1)
+
+        espec = {"w_gate": P("ep", None, "tp"),
+                 "w_up": P("ep", None, "tp"),
+                 "w_down": P("ep", "tp", None)}
+        # Pin the token sharding OUTSIDE the region to the plain batch
+        # axes: without the pin the boundary's dp·fsdp·ep spec propagates
+        # an 8-way batch sharding back onto the residual stream, which
+        # collides with the fsdp embed sharding of the dense weights
+        # (involuntary full rematerialization).  The ep refinement then
+        # happens at the shard_map boundary as a cheap slice.
+        token_pin = NamedSharding(mesh, P(("dp", "fsdp")))
+        flat = jax.lax.with_sharding_constraint(flat, token_pin)
         fn = shard_map(
-            lambda tok, rk, pr: moe_layer_local(
-                tok, rk, expert_fn, pr, axis_name="ep",
-                capacity_factor=cfg.capacity_factor,
-                buffer_constraint=lambda x:
-                    jax.lax.with_sharding_constraint(x, repl)),
+            local_moe,
             mesh=mesh,
-            in_specs=(P("ep"), P(), P("ep")),
-            out_specs=(P("ep"), P()),
-            axis_names={"ep"},
+            in_specs=(P(("dp", "fsdp", "ep")), P(), espec),
+            out_specs=(P(("dp", "fsdp", "ep")), P()),
             check_vma=False)
         out, aux = fn(flat, lp["router"].astype(jnp.float32), eparams)
+        out = jax.lax.with_sharding_constraint(out, token_pin)
+        aux = aux[0]
     else:
         # Single expert group: same math without the exchange.
         from ..parallel.moe import switch_route
@@ -623,12 +672,15 @@ def _forward_pipelined(params: dict, tokens: jax.Array, cfg: LlamaConfig,
         rope = _rope_tables(positions, cfg.rope_theta, cfg.head_dim)
         out, aux = pipeline_apply_local(make_stage_fn(rope), local_layers,
                                         mbs, axis_name="pp", with_aux=True)
-        return out.reshape(B_loc, S_loc, D), aux
+        # aux rides out as shape [1]: rank-0 outputs of differentiated
+        # shard_maps trip a spec error on 0.4.x jaxlib.
+        return out.reshape(B_loc, S_loc, D), aux.reshape(1)
 
     layer_specs, act_spec = parts["layer_specs"], parts["act_spec"]
     fn = shard_map(local, mesh=mesh, in_specs=(layer_specs, act_spec),
                    out_specs=(act_spec, P()), check_vma=False)
     h, aux = fn(params["layers"], h)
+    aux = aux[0]
     h = shd.constrain(h, ("batch", "seq", None), mesh)
     h = _rmsnorm(h, params["final_norm"])
     logits = jnp.einsum("bsd,dv->bsv", h, params["lm_head"])
@@ -660,11 +712,12 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, *,
         # resharding on every layer (round-2 verdict finding).
         layer_dims = {k: d[1:]
                       for k, d in param_logical_dims(cfg)["layers"].items()}
+        rules = shard_rules(cfg, mesh)
 
     def layer_body(carry, lp):
         h, aux = carry
         if mesh is not None:
-            lp = {k: shd.constrain(v, layer_dims[k], mesh)
+            lp = {k: shd.constrain(v, layer_dims[k], mesh, rules)
                   for k, v in lp.items()}
         h = _attn_block(h, lp, rope, cfg,
                         lambda q, k, v: _attention(q, k, v, mesh, causal,
@@ -703,18 +756,20 @@ def _layer_kv(x, lp, rope):
 def _cached_attend(q, keys, vals, mask, scale):
     """Decode-path attention against a KV cache, GQA-grouped.
 
-    q [B,Sq,H,Dh]; keys/vals [B,T,KV,Dh]; mask [Sq,T] bool.  The q heads
-    are reshaped [KV, rep] and contracted against the grouped cache
-    directly — the cache is never expanded to H heads (the repeat would
-    rep x the dominant HBM traffic of decoding, which is exactly reading
-    the cache)."""
+    q [B,Sq,H,Dh]; keys/vals [B,T,KV,Dh]; mask [Sq,T] bool (shared across
+    the batch) or [B,Sq,T] (per-request — the serving engine's slots sit
+    at different context lengths).  The q heads are reshaped [KV, rep]
+    and contracted against the grouped cache directly — the cache is
+    never expanded to H heads (the repeat would rep x the dominant HBM
+    traffic of decoding, which is exactly reading the cache)."""
     B, Sq, H, Dh = q.shape
     KV = keys.shape[2]
     rep = H // KV
     qg = q.reshape(B, Sq, KV, rep, Dh)
     s = jnp.einsum("bqgrd,bkgd->bgrqk", qg, keys
                    ).astype(jnp.float32) * scale
-    s = jnp.where(mask[None, None, None], s, -1e30)
+    m = mask[None, None, None] if mask.ndim == 2 else mask[:, None, None]
+    s = jnp.where(m, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bgrqk,bkgd->bqgrd", p.astype(vals.dtype), vals)
     return o.reshape(B, Sq, H, Dh)
@@ -935,7 +990,8 @@ def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig, *,
         # tensor of the whole decode — on every tp rank.
         if mesh is None:
             return c
-        return shd.constrain(c, ("batch", None, "kv_heads", None), mesh)
+        return shd.constrain(c, ("batch", None, "kv_heads", None), mesh,
+                             shard_rules(cfg, mesh))
 
     # ---- prefill: build the cache over the prompt ----------------------
     h = _embed_lookup(params["embed"], prompt, cfg.dtype)
@@ -1008,6 +1064,133 @@ def generate(params: dict, prompt: jax.Array, cfg: LlamaConfig, *,
     return jnp.concatenate([prompt, new_toks], axis=1)
 
 
+# ---------------------------------------------------------------------------
+# Serving entry points (horovod_tpu/serving: continuous batching over a
+# block-paged KV cache).  The math mirrors the batch generate() paths op
+# for op, so greedy decode through the engine reproduces generate()'s
+# tokens; only cache PLACEMENT differs (the engine owns the page pool).
+# ---------------------------------------------------------------------------
+
+def prefill_step(params, tokens: jax.Array, cfg: LlamaConfig, *,
+                 mesh: Optional[Mesh] = None,
+                 last_pos: Optional[jax.Array] = None
+                 ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Prompt prefill for the serving engine.
+
+    tokens [B, P] int32 → (next-token greedy tokens' logits [B, V] fp32,
+    per-layer K [L, B, P, KV, Dh], per-layer V).  ``last_pos`` [B] selects
+    the logits position per row (bucketed prompts are right-padded: the
+    real last token sits at ``len-1``, not ``P-1``); None means ``P-1``.
+    Causality makes the padded tail inert for every real position, so a
+    bucketed prefill emits the same token as an exact-length one."""
+    B, P = tokens.shape
+    scale = 1.0 / np.sqrt(cfg.head_dim)
+    rules = shard_rules(cfg, mesh)
+    h = _embed_lookup(params["embed"], tokens, cfg.dtype)
+    if mesh is not None:
+        h = shd.constrain(h, ("batch", None, None), mesh, rules)
+    positions = jnp.broadcast_to(jnp.arange(P), (B, P))
+    rope_p = _rope_tables(positions, cfg.rope_theta, cfg.head_dim)
+    mask = jnp.tril(jnp.ones((P, P), bool))
+
+    def layer(h, lp):
+        x = _rmsnorm(h, lp["attn_norm"])
+        q = _rope(jnp.einsum("bsd,dhk->bshk", x, lp["wq"]), rope_p)
+        k, v = _layer_kv(x, lp, rope_p)
+        attn = _cached_attend(q, k, v, mask, scale)
+        h = h + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+        h = h + _dense_mlp(_rmsnorm(h, lp["mlp_norm"]), lp)
+        if mesh is not None:
+            k = shd.constrain(k, ("batch", None, "kv_heads", None), mesh,
+                              rules)
+            v = shd.constrain(v, ("batch", None, "kv_heads", None), mesh,
+                              rules)
+        return h, (k, v)
+
+    h, (ks, vs) = lax.scan(layer, h, params["layers"])
+    if last_pos is None:
+        h_last = h[:, -1]
+    else:
+        h_last = jnp.take_along_axis(
+            h, last_pos[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    logits = jnp.einsum("bd,dv->bv", _rmsnorm(h_last, params["final_norm"]),
+                        params["lm_head"]).astype(jnp.float32)
+    if mesh is not None:
+        logits = shd.constrain(logits, ("batch", "vocab"), mesh, rules)
+    return logits, ks, vs
+
+
+def decode_step_paged(params, tok: jax.Array, positions: jax.Array,
+                      k_pool: jax.Array, v_pool: jax.Array,
+                      tables: jax.Array, cfg: LlamaConfig, *,
+                      mesh: Optional[Mesh] = None, use_flash: bool = False,
+                      interpret: bool = False
+                      ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode tick for the serving engine against the paged pool.
+
+    tok [B] int32 (this tick's input token per slot); positions [B] its
+    absolute position; k_pool/v_pool [L, NB, BS, KV, Dh]; tables
+    [B, n_cols] int32 block tables (inactive rows all-scratch).  Each
+    layer writes its fresh K/V into ``tables[b][positions[b] // BS]`` at
+    offset ``positions[b] % BS`` and attends over the table's logical
+    window with a per-request ``<= position`` mask (stale slots masked).
+    The attention reads the pool either through a contiguous gather (XLA
+    path, GSPMD-shardable) or the Pallas paged kernel's scalar-prefetch
+    block routing (``use_flash``).  Returns (logits [B, V] fp32, k_pool,
+    v_pool) — pass the pools donated so the writes land in place."""
+    from ..serving.kv_pager import gather_blocks
+
+    B = tok.shape[0]
+    L, NB, BS, KV, Dh = k_pool.shape
+    scale = 1.0 / np.sqrt(cfg.head_dim)
+    rules = shard_rules(cfg, mesh)
+    T = tables.shape[1] * BS
+    h = _embed_lookup(params["embed"], tok[:, None], cfg.dtype)
+    if mesh is not None:
+        h = shd.constrain(h, ("batch", None, None), mesh, rules)
+    rope_1 = _rope_tables(positions[:, None], cfg.rope_theta, cfg.head_dim)
+    mask = (jnp.arange(T)[None, :] <= positions[:, None])[:, None, :]
+    b_idx = jnp.arange(B)
+    blk = tables[b_idx, positions // BS]                       # [B]
+    off = positions % BS
+
+    def constrain_pool(p):
+        if mesh is None:
+            return p
+        return shd.constrain(p, (None, None, None, "kv_heads", None),
+                             mesh, rules)
+
+    def layer(carry, xs):
+        h, kp, vp = carry
+        lp, li = xs
+        x = _rmsnorm(h, lp["attn_norm"])
+        q = _rope(jnp.einsum("bsd,dhk->bshk", x, lp["wq"]), rope_1)
+        k1, v1 = _layer_kv(x, lp, rope_1)                  # [B, 1, KV, Dh]
+        kp = constrain_pool(kp.at[li, blk, off].set(k1[:, 0]))
+        vp = constrain_pool(vp.at[li, blk, off].set(v1[:, 0]))
+        if use_flash:
+            from ..ops import flash_attention as FA
+            attn = FA.paged_attention(
+                q[:, 0], kp[li], vp[li], tables, positions + 1,
+                scale=scale, interpret=interpret)[:, None]
+        else:
+            keys = gather_blocks(kp[li], tables)           # [B, T, KV, Dh]
+            vals = gather_blocks(vp[li], tables)
+            attn = _cached_attend(q, keys, vals, mask, scale)
+        h = h + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+        h = h + _dense_mlp(_rmsnorm(h, lp["mlp_norm"]), lp)
+        return (h, kp, vp), None
+
+    (h, k_pool, v_pool), _ = lax.scan(
+        layer, (h, k_pool, v_pool), (params["layers"], jnp.arange(L)))
+    logits = jnp.einsum("bd,dv->bv",
+                        _rmsnorm(h[:, 0], params["final_norm"]),
+                        params["lm_head"]).astype(jnp.float32)
+    if mesh is not None:
+        logits = shd.constrain(logits, ("batch", "vocab"), mesh, rules)
+    return logits, k_pool, v_pool
+
+
 def _use_blockwise_ce(cfg: LlamaConfig, mesh: Optional[Mesh]) -> bool:
     if not cfg.blockwise_ce:
         return False
@@ -1042,6 +1225,34 @@ def loss_fn(params: dict, batch: dict, cfg: LlamaConfig, *,
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     return (lse - picked).mean() + cfg.moe_aux_weight * aux
+
+
+def _opt_shardings(tx, cfg: LlamaConfig, mesh: Mesh):
+    """Explicit shardings for the optimizer state: every param-shaped
+    subtree (adam mu/nu, momentum, ...) mirrors the parameter shardings,
+    anything else (step counters) replicates.
+
+    jit with donated arguments needs these spelled out: leaving the opt
+    state's shardings to inference lets the propagator pick layouts that
+    disagree with the donated inputs on tp/sp meshes, and XLA aliasing
+    fails at runtime with a sub-shape size mismatch."""
+    pshard = param_shardings(cfg, mesh)
+    repl = NamedSharding(mesh, P())
+    params_aval = jax.eval_shape(partial(init_params, cfg),
+                                 jax.random.PRNGKey(0))
+    ptree = jax.tree.structure(params_aval)
+    state_aval = jax.eval_shape(tx.init, params_aval)
+
+    def is_param_subtree(x):
+        try:
+            return jax.tree.structure(x) == ptree
+        except Exception:  # pragma: no cover - exotic leaves
+            return False
+
+    return jax.tree.map(
+        lambda sub: pshard if is_param_subtree(sub)
+        else jax.tree.map(lambda _: repl, sub),
+        state_aval, is_leaf=is_param_subtree)
 
 
 def _make_train_step_1f1b(cfg: LlamaConfig, mesh: Mesh, tx):
@@ -1188,8 +1399,9 @@ def _make_train_step_1f1b(cfg: LlamaConfig, mesh: Mesh, tx):
         params = jax.tree.map(jnp.add, params, updates)
         return params, opt_state, loss + cfg.moe_aux_weight * aux
 
-    return jax.jit(step, in_shardings=(pshard, None, batch_shard),
-                   out_shardings=(pshard, None, repl),
+    opt_shard = _opt_shardings(tx, cfg, mesh)
+    return jax.jit(step, in_shardings=(pshard, opt_shard, batch_shard),
+                   out_shardings=(pshard, opt_shard, repl),
                    donate_argnums=(0, 1))
 
 
@@ -1226,7 +1438,7 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx, *,
         params = jax.tree.map(jnp.add, params, updates)
         return params, opt_state, loss
 
-    opt_shard = None  # inferred
+    opt_shard = _opt_shardings(tx, cfg, mesh)
     return jax.jit(
         step,
         in_shardings=(pshard, opt_shard, batch_shard),

@@ -23,7 +23,8 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+from ..jaxcompat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from . import collectives as C

@@ -361,6 +361,124 @@ def _flash_backward(q, k, v, out, lse, g, *, scale, causal, block_q,
 
 
 # ---------------------------------------------------------------------------
+# paged decode kernel (serving: block-paged KV cache)
+# ---------------------------------------------------------------------------
+
+def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
+                         o_ref, m_scr, l_scr, acc_scr, *, block_size: int,
+                         scale: float):
+    """One (request, kv-head-group, table-column) grid step of paged
+    decode attention: online-softmax accumulate this physical block's
+    contribution for the group's ``rep`` query heads.
+
+    The block table never touches the kernel body's data path — it rides
+    the scalar-prefetch channel and the K/V BlockSpec index maps below
+    route each grid step straight to its physical page, the same
+    grouped-KV index-map routing ``_kv_row_map`` gives the training
+    kernel (GQA-native: K/V pages stay at kv_heads width)."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    n_cols = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, 0]                                   # [rep, Dh]
+    k = k_ref[0, :, 0, :]                             # [BS, Dh]
+    v = v_ref[0, :, 0, :]
+    # bf16 operands on the MXU, fp32 accumulation (see _fwd_kernel).
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    rep = q.shape[0]
+    pos = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (rep, block_size), 1)
+    s = jnp.where(pos < lengths_ref[b], s, _NEG_INF)
+    m_prev = m_scr[...]                               # [rep, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                            # [rep, BS]
+    l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    m_scr[...] = m_new
+
+    @pl.when(j == n_cols - 1)
+    def _flush():
+        l_safe = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0, 0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+def paged_supported(block_size: int, head_dim: int) -> bool:
+    """Pool geometries the paged decode kernel handles: sublane-aligned
+    pages and a lane-bounded head dim (mirrors :func:`supported`)."""
+    return block_size % 8 == 0 and head_dim <= 256
+
+
+def paged_attention(q, k_pool, v_pool, tables, lengths, *,
+                    scale: Optional[float] = None, interpret: bool = False):
+    """Decode-step attention over a block-paged KV pool, GQA-native.
+
+    q [B, H, Dh] (one token per request); k_pool/v_pool
+    [num_blocks, block_size, KV, Dh]; tables [B, n_cols] int32 physical
+    block ids (rows padded with the scratch block 0); lengths [B] —
+    logical positions ``< lengths[b]`` are live, the rest masked.
+
+    The table rides ``PrefetchScalarGridSpec``'s scalar-prefetch channel
+    so the K/V BlockSpec index maps dereference it per grid step — no
+    gathered ``[B, T, KV, Dh]`` copy ever lands in HBM (the XLA fallback
+    in the serving engine materializes exactly that copy).  Returns
+    [B, H, Dh].
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Dh = q.shape
+    NB, BS, KV, _ = k_pool.shape
+    if H % KV:
+        raise ValueError(f"kv heads {KV} must divide q heads {H}")
+    rep = H // KV
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(Dh))
+    n_cols = tables.shape[1]
+    qg = q.reshape(B, KV, rep, Dh)      # group-major, as _cached_attend
+
+    kernel = functools.partial(_paged_decode_kernel, block_size=BS,
+                               scale=scale)
+    kv_spec = pl.BlockSpec(
+        (1, BS, 1, Dh),
+        lambda b, g, j, tbl, ln: (tbl[b, j], 0, g, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KV, n_cols),
+        in_specs=[
+            pl.BlockSpec((1, 1, rep, Dh),
+                         lambda b, g, j, tbl, ln: (b, g, 0, 0)),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=pl.BlockSpec((1, 1, rep, Dh),
+                               lambda b, g, j, tbl, ln: (b, g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, Dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, rep, Dh), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32), qg,
+      k_pool, v_pool)
+    return out.reshape(B, H, Dh)
+
+
+# ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
 

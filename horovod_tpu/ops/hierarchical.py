@@ -33,7 +33,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+from ..jaxcompat import axis_size, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -45,8 +46,8 @@ def hierarchical_allreduce_local(v: jax.Array, *, local_axis: str,
     v: this device's full tensor [*shape] (replic-intent).  Returns the
     global sum (or mean) with the cross-axis hop carrying 1/n_local bytes.
     """
-    n_local = lax.axis_size(local_axis)
-    n_cross = lax.axis_size(cross_axis)
+    n_local = axis_size(local_axis)
+    n_cross = axis_size(cross_axis)
     shape = v.shape
     flat = v.reshape(-1)
     pad = (-flat.size) % n_local

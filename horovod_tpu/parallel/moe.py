@@ -21,7 +21,8 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+from ..jaxcompat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -72,7 +73,7 @@ def moe_layer_local(tokens: jax.Array,
     the expert dim of the saved-for-backward buffers and pays an
     involuntary full rematerialization each layer.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     T, D = tokens.shape
     E_total = router_kernel.shape[1]
     if E_total % n:

@@ -28,7 +28,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+from ..jaxcompat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 _NEG_INF = -1e30
@@ -56,7 +57,7 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
     returns the same shape.  Call inside ``shard_map``/``pjit``-mapped code
     whose ``axis_name`` axis shards the sequence dimension.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, L, H, D = q.shape
     if scale is None:
@@ -132,7 +133,7 @@ def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
     Uses the same alltoall primitive the collective layer must provide
     anyway (SURVEY §5.7); preferable when heads % n == 0 and sequence fits.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     B, L, H, D = q.shape
     if H % n:
         raise ValueError(

@@ -58,6 +58,39 @@ def logical_sharding(mesh: Mesh, logical_dims: Sequence[Optional[str]],
     return NamedSharding(mesh, spec_for(logical_dims, rules))
 
 
+def fitted_rules(mesh: Mesh, dim_sizes: dict[str, int],
+                 rules: Optional[dict] = None) -> dict:
+    """Mesh-aware rule overrides: for each logical dim in ``dim_sizes``,
+    keep the longest prefix of its mapped mesh axes whose product divides
+    the dim size, degrading to replication when even the first axis does
+    not divide (e.g. ``kv_heads=2`` on a ``tp=4`` mesh).
+
+    Sharding a dim over axes that do not divide it is not merely padded by
+    GSPMD — jitted init with such out_shardings is rejected outright, and
+    the model's grouped-KV dispatch would silently fall off its fast path.
+    Returns an override dict to pass as ``rules`` to :func:`spec_for` /
+    :func:`constrain` / :func:`logical_sharding`.
+    """
+    base = {**DEFAULT_RULES, **(rules or {})}
+    out = dict(rules or {})
+    for dim, size in dim_sizes.items():
+        axes = base.get(dim)
+        if axes is None:
+            continue
+        axes_t = (axes,) if isinstance(axes, str) else tuple(axes)
+        kept: list[str] = []
+        prod = 1
+        for a in axes_t:
+            n = mesh.shape.get(a, 1)
+            if n > 1 and size % (prod * n) != 0:
+                break
+            kept.append(a)
+            prod *= n
+        if len(kept) != len(axes_t):
+            out[dim] = tuple(kept) if kept else None
+    return out
+
+
 def spec_axes(spec: P) -> set:
     """The set of mesh axis names a PartitionSpec references."""
     out = set()

@@ -1,0 +1,192 @@
+"""The serving front door: ``serve()`` → submit futures, stream tokens.
+
+Wraps :class:`~horovod_tpu.serving.engine.ServingEngine` with the
+request-facing surface a client sees:
+
+- ``submit(prompt, max_tokens) -> concurrent.futures.Future`` resolving
+  to a :class:`RequestResult` (tokens + per-request metrics);
+- optional per-token streaming callbacks, invoked in emission order;
+- per-request metrics — TTFT, queue wait, decode tok/s — logged through
+  :mod:`horovod_tpu.utils.logging` and traced as QUEUE (submit → first
+  token, prefill included) → DECODE spans on
+  :class:`horovod_tpu.utils.timeline.Timeline` (one timeline row per
+  request, the reference's per-tensor layout).
+
+The loop can be driven synchronously (:meth:`ServingSession.drain` — the
+deterministic mode tests and benchmarks use) or by a background thread
+(:meth:`ServingSession.start`), with submissions safe from any thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from ..utils import logging as hvd_logging
+from ..utils.timeline import Timeline
+from .engine import EngineConfig, ServingEngine
+from .scheduler import Request
+
+log = hvd_logging.get_logger()
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """What a submit() future resolves to."""
+
+    req_id: int
+    prompt: np.ndarray
+    tokens: list[int]          # the generated continuation
+    metrics: dict              # ttft_s, queue_wait_s, decode_tokens_per_s…
+
+    @property
+    def full_sequence(self) -> np.ndarray:
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+
+class ServingSession:
+    """One live engine + its request-facing bookkeeping."""
+
+    def __init__(self, engine: ServingEngine, *,
+                 timeline: Optional[Timeline] = None) -> None:
+        self.engine = engine
+        self._timeline = timeline or Timeline(None)
+        self._futures: dict[int, Future] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- client surface --------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_tokens: int, *,
+               eos_token: Optional[int] = None,
+               stream_cb: Optional[Callable[[int, int], None]] = None
+               ) -> Future:
+        """Queue a request; the future resolves to a
+        :class:`RequestResult`.  ``stream_cb(req_id, token)`` fires once
+        per generated token, in order."""
+        fut: Future = Future()
+        with self._lock:
+            req = self.engine.submit(prompt, max_tokens,
+                                     eos_token=eos_token,
+                                     stream_cb=stream_cb)
+            self._futures[req.req_id] = fut
+        self._timeline.start_activity(f"req{req.req_id}", "QUEUE")
+        return fut
+
+    def drain(self, max_steps: Optional[int] = None) -> None:
+        """Synchronously step the engine until every request finished."""
+        n = 0
+        while self.engine.has_work():
+            self._step_once()
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+
+    def start(self) -> "ServingSession":
+        """Background serving thread (the example's interactive mode)."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    with self._lock:
+                        busy = self.engine.has_work()
+                    if busy:
+                        self._step_once()
+                    else:
+                        time.sleep(0.001)
+                except Exception as e:  # engine died: fail every future
+                    with self._lock:
+                        futs = list(self._futures.values())
+                        self._futures.clear()
+                    for fut in futs:
+                        if not fut.done():
+                            fut.set_exception(e)
+                    log.exception("serving thread stopped on engine error")
+                    return
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="hvdtpu-serving")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join()
+            self._thread = None
+        self._timeline.close()
+
+    def __enter__(self) -> "ServingSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- engine pump -----------------------------------------------------
+    def _step_once(self) -> None:
+        with self._lock:
+            emissions = self.engine.step()
+            failed = self.engine.pop_failed()
+        for req, exc in failed:
+            self._timeline.end_activity(f"req{req.req_id}")
+            fut = self._futures.pop(req.req_id, None)
+            if fut is not None and not fut.done():
+                fut.set_exception(exc)
+        now = time.monotonic()
+        for req, token in emissions:
+            name = f"req{req.req_id}"
+            if req.t_first_token is None:
+                req.t_first_token = now
+                self._timeline.end_activity(name)          # QUEUE/PREFILL
+                self._timeline.start_activity(name, "DECODE")
+            if req.stream_cb is not None:
+                req.stream_cb(req.req_id, token)
+            if req.state.value == "finished":
+                self._resolve(req)
+
+    def _resolve(self, req: Request) -> None:
+        name = f"req{req.req_id}"
+        self._timeline.end_activity(name)
+        m = req.metrics()
+        log.info(
+            "serving req=%d prompt=%d new=%d queue_wait=%.4fs ttft=%.4fs "
+            "decode_tok_s=%s preemptions=%d",
+            m["req_id"], m["prompt_len"], m["new_tokens"],
+            m["queue_wait_s"] or 0.0, m["ttft_s"] or 0.0,
+            f"{m['decode_tokens_per_s']:.1f}"
+            if m["decode_tokens_per_s"] else "n/a", m["preemptions"])
+        fut = self._futures.pop(req.req_id, None)
+        if fut is not None and not fut.done():
+            fut.set_result(RequestResult(
+                req_id=req.req_id, prompt=req.prompt,
+                tokens=list(req.generated), metrics=m))
+
+
+def serve(params: Any, cfg, *, mesh=None,
+          engine_cfg: Optional[EngineConfig] = None,
+          timeline: Optional[Timeline] = None, **engine_kw
+          ) -> ServingSession:
+    """Build a serving session for a model.
+
+    ``engine_cfg`` carries the pool/scheduler knobs; keyword overrides
+    (``block_size=…``, ``num_blocks=…``, …) are applied on top::
+
+        session = serve(params, cfg, num_blocks=256, max_active=16)
+        fut = session.submit(prompt_ids, max_tokens=64)
+        session.drain()
+        print(fut.result().tokens)
+    """
+    base = engine_cfg or EngineConfig()
+    if engine_kw:
+        base = dataclasses.replace(base, **engine_kw)
+    engine = ServingEngine(params, cfg, engine_cfg=base, mesh=mesh)
+    return ServingSession(engine, timeline=timeline)

@@ -1,0 +1,320 @@
+"""The serving loop: compiled prefill/decode steps over the page pool.
+
+Transport half of the policy/transport split (the scheduler decides what
+runs; this owns how it runs on devices):
+
+- **Page pool** — ``[L, num_blocks, block_size, KV, Dh]`` K and V arrays,
+  allocated once, donated through every jitted step so writes land in
+  place.  On a mesh the pool is constrained ``kv_heads`` over tp (the
+  round-5 never-replicate-the-cache rule) and activations ``batch`` over
+  dp·fsdp, via :mod:`horovod_tpu.parallel.sharding` logical rules.
+- **Bucketed shapes** — prompts are right-padded to a bucket length and
+  decode block tables to a power-of-two column count, so the number of
+  distinct compiled shapes is logarithmic in the workload spread rather
+  than linear (each novel shape is a fresh XLA compile).
+- **Fixed decode batch** — the decode step always runs ``max_active``
+  slots; inactive slots carry token 0 at position 0 against an
+  all-scratch block table (block 0 is reserved), so their masked writes
+  are harmless and their logits are ignored.
+- **Greedy decode** — token-identical to batch
+  :func:`~horovod_tpu.models.llama.generate` on the same prompts (the
+  model-side steps reuse its math op for op); asserted in
+  ``tests/test_serving.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import numpy as np
+
+from ..models import llama
+from ..utils import logging as hvd_logging
+from .kv_pager import KVPager, PagedKVCache
+from .scheduler import Request, Scheduler
+
+log = hvd_logging.get_logger()
+
+
+def _bucket_pow2(n: int, floor: int = 1) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Serving-engine knobs (model geometry comes from ``LlamaConfig``)."""
+
+    #: tokens per KV block (pool page size)
+    block_size: int = 16
+    #: total pool blocks (block 0 is scratch; HBM budget knob)
+    num_blocks: int = 128
+    #: decode slots — the fixed compiled decode batch
+    max_active: int = 8
+    #: max prompt tokens admitted to prefill per step (bounds the latency
+    #: a decode tick can see; an over-budget prompt still runs, alone)
+    prefill_token_budget: int = 512
+    #: round prompt lengths up to one of these before compiling; empty =
+    #: exact lengths (one compile per distinct prompt length)
+    prefill_buckets: tuple = ()
+    #: "auto" (Pallas paged kernel on TPU), "never" (XLA gather), or
+    #: "interpret" (kernel through the Pallas interpreter — CPU testing)
+    use_flash: str = "auto"
+
+
+class ServingEngine:
+    """Continuous-batching engine over one model + page pool.
+
+    Drive it with :meth:`submit` + :meth:`step` (one scheduler round:
+    retire, admit+prefill, decode tick); :meth:`run` loops until idle.
+    Emitted tokens reach the caller through ``Request.generated`` and the
+    per-token callbacks the API layer wires in.
+    """
+
+    def __init__(self, params: Any, cfg: llama.LlamaConfig, *,
+                 engine_cfg: EngineConfig = EngineConfig(),
+                 mesh=None) -> None:
+        if cfg.use_moe:
+            raise NotImplementedError("serving does not support MoE configs")
+        self.params = params
+        self.cfg = cfg
+        self.ecfg = engine_cfg
+        self.mesh = mesh
+        if mesh is not None:
+            dpf = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+            if engine_cfg.max_active % dpf:
+                raise ValueError(
+                    f"max_active={engine_cfg.max_active} must divide over "
+                    f"dp*fsdp={dpf}")
+            for a in ("sp", "ep", "pp"):
+                if mesh.shape.get(a, 1) > 1:
+                    raise NotImplementedError(
+                        "serving supports dp/fsdp/tp meshes; "
+                        f"{a} is a training-path axis here")
+        import jax
+        import jax.numpy as jnp
+        self._jax, self._jnp = jax, jnp
+
+        self.cache = PagedKVCache(
+            n_layers=cfg.n_layers, num_blocks=engine_cfg.num_blocks,
+            block_size=engine_cfg.block_size, kv_heads=cfg.n_kv_heads,
+            head_dim=cfg.head_dim)
+        self.pager = KVPager(self.cache)
+        self.scheduler = Scheduler(
+            self.pager, max_active=engine_cfg.max_active,
+            prefill_token_budget=engine_cfg.prefill_token_budget)
+
+        def fresh_pool():
+            pool = jnp.zeros(self.cache.shape, cfg.dtype)
+            if mesh is not None:
+                from ..parallel import sharding as shd
+                pool = jax.device_put(pool, shd.logical_sharding(
+                    mesh, (None, None, None, "kv_heads", None),
+                    llama.shard_rules(cfg, mesh)))
+            return pool
+
+        self.k_pool = fresh_pool()
+        self.v_pool = fresh_pool()
+
+        self._slots: list[Optional[Request]] = \
+            [None] * engine_cfg.max_active
+        self._next_id = 0
+        self._steps = 0
+
+        flash = engine_cfg.use_flash
+        from ..ops import flash_attention as FA
+        kernel_ok = FA.paged_supported(engine_cfg.block_size, cfg.head_dim)
+        self._interpret = flash == "interpret"
+        self._use_flash = kernel_ok and (
+            flash == "interpret"
+            or (flash == "auto" and jax.default_backend() == "tpu"))
+
+        # One jit per step kind; bucketing keeps the traced shape set
+        # small and jax's cache does the rest.
+        self._prefill = jax.jit(partial(self._prefill_impl))
+        self._scatter = jax.jit(partial(self._scatter_impl),
+                                donate_argnums=(0, 1))
+        self._decode = jax.jit(partial(self._decode_impl),
+                               donate_argnums=(1, 2))
+
+    # -- jitted step bodies ---------------------------------------------
+    def _prefill_impl(self, params, tokens, last_pos):
+        jnp = self._jnp
+        logits, ks, vs = llama.prefill_step(
+            params, tokens, self.cfg, mesh=self.mesh, last_pos=last_pos)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), ks, vs
+
+    def _scatter_impl(self, kp, vp, ks, vs, blocks):
+        """Write one request's prefill K/V ([L, 1, P, KV, Dh]) into its
+        pool blocks.  P is padded up to a whole number of blocks; the
+        tail slots hold pad-token K/V, masked by position until decode
+        overwrites them one at a time."""
+        jnp = self._jnp
+        L = ks.shape[0]
+        P = ks.shape[2]
+        BS = self.cache.block_size
+        nb = blocks.shape[0]
+        pad = nb * BS - P
+        ks = jnp.pad(ks[:, 0], ((0, 0), (0, pad), (0, 0), (0, 0)))
+        vs = jnp.pad(vs[:, 0], ((0, 0), (0, pad), (0, 0), (0, 0)))
+        ks = ks.reshape(L, nb, BS, *ks.shape[2:])
+        vs = vs.reshape(L, nb, BS, *vs.shape[2:])
+        return kp.at[:, blocks].set(ks), vp.at[:, blocks].set(vs)
+
+    def _decode_impl(self, params, kp, vp, tok, pos, tables):
+        jnp = self._jnp
+        logits, kp, vp = llama.decode_step_paged(
+            params, tok, pos, kp, vp, tables, self.cfg, mesh=self.mesh,
+            use_flash=self._use_flash, interpret=self._interpret)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), kp, vp
+
+    # -- public surface --------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, *, eos_token=None,
+               stream_cb=None) -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        need = self.cache.blocks_for(int(prompt.size) + 1)
+        usable = self.cache.num_blocks - 1
+        if need > usable:
+            # Reject up front: an unfillable prompt at the head of the
+            # strictly-FIFO queue would otherwise livelock admission.
+            raise ValueError(
+                f"prompt of {prompt.size} tokens needs {need} blocks; the "
+                f"pool only has {usable} (raise num_blocks/block_size)")
+        req = Request(req_id=self._next_id, prompt=prompt,
+                      max_new_tokens=max_new_tokens, eos_token=eos_token,
+                      stream_cb=stream_cb)
+        self._next_id += 1
+        self.scheduler.submit(req)
+        return req
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    def pop_failed(self) -> list:
+        """Requests the scheduler declared unrunnable (e.g. a preempted
+        request whose folded-in progress no longer fits the pool), as
+        ``(request, exception)`` pairs — callers fail their futures."""
+        failed = self.scheduler.failed
+        self.scheduler.failed = []
+        return failed
+
+    def step(self) -> list[tuple[Request, int]]:
+        """One serving round; returns the (request, token) emissions."""
+        emitted: list[tuple[Request, int]] = []
+        self._steps += 1
+        for req in self.scheduler.admit():
+            self._assign_slot(req)
+            emitted.append((req, self._prefill_one(req)))
+        if self.scheduler.running:
+            emitted.extend(self._decode_tick())
+        return emitted
+
+    def run(self, max_steps: Optional[int] = None
+            ) -> list[tuple[Request, int]]:
+        """Steps until the queue drains; returns all emissions in order."""
+        out: list[tuple[Request, int]] = []
+        n = 0
+        while self.has_work():
+            out.extend(self.step())
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+        return out
+
+    # -- internals -------------------------------------------------------
+    def _assign_slot(self, req: Request) -> None:
+        i = self._slots.index(None)
+        self._slots[i] = req
+
+    def _drop_slot(self, req: Request) -> None:
+        self._slots[self._slots.index(req)] = None
+
+    def _sync_slots(self) -> None:
+        """Preemption inside scheduler.grow() removes requests from the
+        running set behind the engine's back; drop their slots."""
+        running = set(id(r) for r in self.scheduler.running)
+        for i, r in enumerate(self._slots):
+            if r is not None and id(r) not in running:
+                self._slots[i] = None
+
+    def _bucket_len(self, n: int) -> int:
+        for b in self.ecfg.prefill_buckets:
+            if n <= b:
+                return b
+        return n
+
+    def _prefill_one(self, req: Request) -> int:
+        jnp = self._jnp
+        toks = req.prefill_tokens
+        P = int(toks.shape[0])
+        Pb = self._bucket_len(P)
+        padded = np.zeros((1, Pb), np.int32)
+        padded[0, :P] = toks
+        tok, ks, vs = self._prefill(
+            self.params, jnp.asarray(padded),
+            jnp.asarray([P - 1], jnp.int32))
+        blocks = self.pager.table(req.req_id)
+        nb = self.cache.blocks_for(P)
+        # Only the blocks the P real positions span are written; the +1
+        # slot block (allocated for the emitted token) is untouched here.
+        lim = min(Pb, nb * self.cache.block_size)
+        ks, vs = ks[:, :, :lim], vs[:, :, :lim]
+        self.k_pool, self.v_pool = self._scatter(
+            self.k_pool, self.v_pool, ks, vs,
+            jnp.asarray(blocks[:nb], jnp.int32))
+        return self._emit(req, int(tok[0]))
+
+    def _decode_tick(self) -> list[tuple[Request, int]]:
+        jnp = self._jnp
+        # Reserve the write position for every running request first —
+        # growth can preempt, shrinking the running set.
+        for req in list(self.scheduler.running):
+            if req in self.scheduler.running:
+                self.scheduler.grow(req)
+        self._sync_slots()
+        active = [r for r in self._slots if r is not None]
+        if not active:
+            return []
+        R = self.ecfg.max_active
+        need_cols = max(
+            self.cache.blocks_for(r.context_len + 1) for r in active)
+        n_cols = min(_bucket_pow2(need_cols), self.cache.num_blocks)
+        tok = np.zeros((R,), np.int32)
+        pos = np.zeros((R,), np.int32)
+        ids = [-1] * R
+        for i, r in enumerate(self._slots):
+            if r is None:
+                continue
+            tok[i] = r.generated[-1]
+            pos[i] = r.context_len
+            ids[i] = r.req_id
+        tables = self.pager.table_matrix(ids, n_cols)
+        nxt, self.k_pool, self.v_pool = self._decode(
+            self.params, self.k_pool, self.v_pool,
+            jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(tables))
+        nxt = np.asarray(nxt)
+        emitted = []
+        for i, r in enumerate(list(self._slots)):
+            if r is None:
+                continue
+            r.context_len += 1          # this tick wrote pos[i]
+            emitted.append((r, self._emit(r, int(nxt[i]))))
+        return emitted
+
+    def _emit(self, req: Request, token: int) -> int:
+        req.generated.append(token)
+        done = (len(req.generated) >= req.max_new_tokens
+                or (req.eos_token is not None and token == req.eos_token))
+        if done:
+            self.scheduler.finish(req)
+            self._drop_slot(req)
+        return token

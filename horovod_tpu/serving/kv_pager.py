@@ -1,0 +1,169 @@
+"""Block-paged KV cache: the allocator and the device-side page pool.
+
+The batch `generate()` cache is ``[B, T, KV, D]`` with ``T = prompt +
+max_new`` — every request pays worst-case memory up front, and the batch
+dimension is welded shut.  Here the same grouped layout is cut into
+fixed-size blocks pooled across requests (PagedAttention, Kwon et al.;
+vLLM's central idea):
+
+- device pool: ``[L, num_blocks, block_size, KV, D]`` per K and V —
+  one allocation for the whole serving session, never resized;
+- host allocator (:class:`KVPager`): a free list of block ids with
+  per-request block tables mapping logical position ``p`` to physical
+  block ``table[p // block_size]``;
+- attention reads the pool either by gathering a request's blocks into a
+  contiguous ``[B, T_pad, KV, D]`` view (XLA path — a plain take, which
+  GSPMD shards like any other gather) or directly via the Pallas decode
+  kernel's scalar-prefetch BlockSpec routing
+  (:func:`horovod_tpu.ops.flash_attention.paged_attention`), the same
+  grouped-KV index-map trick the training flash kernel uses for GQA.
+
+Block 0 is RESERVED as a scratch target: inactive decode slots in the
+fixed-shape step function point their table rows at it, so their masked
+garbage writes can never land in a live request's block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+class OutOfBlocks(RuntimeError):
+    """The pool has no free block; callers preempt a request and retry."""
+
+
+@dataclasses.dataclass
+class PagedKVCache:
+    """Shape/bookkeeping descriptor for one device-side page pool.
+
+    The jax pool arrays themselves live in the engine (they are donated
+    through the jitted step functions); this object owns the static
+    geometry the allocator and the step builders agree on."""
+
+    n_layers: int
+    num_blocks: int
+    block_size: int
+    kv_heads: int
+    head_dim: int
+
+    @property
+    def shape(self) -> tuple[int, int, int, int, int]:
+        return (self.n_layers, self.num_blocks, self.block_size,
+                self.kv_heads, self.head_dim)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` positions."""
+        return -(-n_tokens // self.block_size)
+
+    def bytes_per_block(self, itemsize: int) -> int:
+        # x2: K and V pools.
+        return (2 * self.n_layers * self.block_size * self.kv_heads
+                * self.head_dim * itemsize)
+
+
+class KVPager:
+    """Free-list block allocator with per-request block tables.
+
+    Invariants (tested):
+    - a block is owned by at most one request at a time;
+    - block 0 is never handed out (scratch target for masked writes);
+    - ``free_blocks + sum(len(table) for live tables) == num_blocks - 1``;
+    - double-free and foreign-free raise.
+    """
+
+    def __init__(self, cache: PagedKVCache) -> None:
+        if cache.num_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is scratch)")
+        self.cache = cache
+        # LIFO free list: recently-freed blocks are re-used first, which
+        # keeps the working set of pool pages dense.
+        self._free: list[int] = list(range(cache.num_blocks - 1, 0, -1))
+        self._tables: dict[int, list[int]] = {}
+
+    # -- queries ---------------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def table(self, req_id: int) -> list[int]:
+        return list(self._tables[req_id])
+
+    def num_tokens_capacity(self) -> int:
+        return self.free_blocks * self.cache.block_size
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return self.cache.blocks_for(n_tokens) <= self.free_blocks
+
+    # -- allocation ------------------------------------------------------
+    def allocate(self, req_id: int, n_tokens: int) -> list[int]:
+        """Fresh table covering ``n_tokens`` for a new request."""
+        if req_id in self._tables:
+            raise ValueError(f"request {req_id} already has a table")
+        need = self.cache.blocks_for(n_tokens)
+        if need > len(self._free):
+            raise OutOfBlocks(
+                f"need {need} blocks for {n_tokens} tokens, "
+                f"{len(self._free)} free")
+        blocks = [self._free.pop() for _ in range(need)]
+        self._tables[req_id] = blocks
+        return list(blocks)
+
+    def extend(self, req_id: int, n_tokens: int) -> list[int]:
+        """Grow ``req_id``'s table to cover ``n_tokens`` total positions;
+        returns the full table.  Raises :class:`OutOfBlocks` (allocator
+        state unchanged) when the pool is exhausted — the scheduler
+        preempts a request and retries."""
+        table = self._tables[req_id]
+        need = self.cache.blocks_for(n_tokens) - len(table)
+        if need <= 0:
+            return list(table)
+        if need > len(self._free):
+            raise OutOfBlocks(
+                f"request {req_id} needs {need} more blocks, "
+                f"{len(self._free)} free")
+        table.extend(self._free.pop() for _ in range(need))
+        return list(table)
+
+    def release(self, req_id: int) -> None:
+        """Return every block of ``req_id`` to the free list."""
+        blocks = self._tables.pop(req_id, None)
+        if blocks is None:
+            raise KeyError(f"request {req_id} holds no blocks")
+        self._free.extend(blocks)
+
+    # -- fixed-shape table matrix for the compiled step ------------------
+    def table_matrix(self, req_ids: list[int], n_cols: int) -> np.ndarray:
+        """``[len(req_ids), n_cols]`` int32 block tables, rows padded with
+        the scratch block 0 (ids of ``-1`` mean an inactive slot — an
+        all-scratch row)."""
+        out = np.zeros((len(req_ids), n_cols), np.int32)
+        for i, rid in enumerate(req_ids):
+            if rid < 0:
+                continue
+            tbl = self._tables[rid][:n_cols]
+            out[i, :len(tbl)] = tbl
+        return out
+
+    def check_invariants(self) -> None:
+        held = [b for tbl in self._tables.values() for b in tbl]
+        assert 0 not in held, "scratch block 0 leaked into a table"
+        assert 0 not in self._free, "scratch block 0 leaked into free list"
+        assert len(set(held)) == len(held), "block owned twice"
+        assert len(held) + len(self._free) == self.cache.num_blocks - 1, \
+            "blocks lost or duplicated"
+
+
+def gather_blocks(pool, table) -> "jax.Array":  # noqa: F821
+    """Contiguous ``[B, n_cols * block_size, KV, D]`` view of each row's
+    blocks: the XLA paged-attention dispatch (a take along the block dim,
+    shardable by GSPMD like any gather).
+
+    pool: ``[num_blocks, block_size, KV, D]`` (one layer's pages);
+    table: ``[B, n_cols]`` int32.
+    """
+    B, n_cols = table.shape
+    g = pool[table]                       # [B, n_cols, BS, KV, D]
+    return g.reshape(B, n_cols * pool.shape[1], *pool.shape[2:])

@@ -1,0 +1,221 @@
+"""Continuous-batching request scheduler (policy, no device code).
+
+Separation of concerns mirrors HiCCL's policy/transport split
+(arXiv:2408.05962): this module decides WHAT runs each step — admission,
+phase split, join/evict, preemption — and the engine owns HOW it runs on
+the mesh.  Everything here is host-side Python over the
+:class:`~horovod_tpu.serving.kv_pager.KVPager` bookkeeping; it never
+touches a jax array, so its invariants are testable without a backend.
+
+Policy:
+- **FIFO admission** — strict arrival order, no head-of-line bypass, so
+  long prompts cannot starve (fairness under mixed lengths is a test).
+- **Prefill token budget** — at most ``prefill_token_budget`` prompt
+  tokens enter prefill per step (always at least one request, so an
+  over-budget prompt still runs — alone).  Bounding prefill work per step
+  bounds the latency decode ticks see between tokens.
+- **Join/evict per step** — finished requests leave and free their blocks
+  before admission, so a drained slot is refilled the same step.
+- **LIFO preemption on OOM** — when a growing request cannot get a block,
+  the youngest running request is preempted: blocks freed, request
+  re-queued at the FRONT with its generated tokens folded into the
+  prompt.  Greedy decode is deterministic, so a preempted request resumes
+  with an identical continuation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from collections import deque
+from typing import Callable, Optional
+
+import numpy as np
+
+from .kv_pager import KVPager, OutOfBlocks
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+
+
+@dataclasses.dataclass
+class Request:
+    """One inference request and its lifecycle bookkeeping."""
+
+    req_id: int
+    prompt: np.ndarray                  # [P] int32 — original prompt
+    max_new_tokens: int
+    eos_token: Optional[int] = None
+    stream_cb: Optional[Callable[[int, int], None]] = None
+    state: RequestState = RequestState.WAITING
+    #: tokens generated so far (grows per decode tick / prefill emit)
+    generated: list[int] = dataclasses.field(default_factory=list)
+    #: prompt actually prefilled (original + generated-before-preemption)
+    prefill_tokens: Optional[np.ndarray] = None
+    #: current context length in the pool (prefilled + generated there)
+    context_len: int = 0
+    preemptions: int = 0
+    # metrics timestamps (time.monotonic)
+    t_submit: float = 0.0
+    t_admitted: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_finished: Optional[float] = None
+
+    @property
+    def remaining_new(self) -> int:
+        return self.max_new_tokens - len(self.generated)
+
+    def metrics(self) -> dict:
+        done = self.t_finished or time.monotonic()
+        ttft = (self.t_first_token - self.t_submit
+                if self.t_first_token is not None else None)
+        decode_s = (done - self.t_first_token
+                    if self.t_first_token is not None else None)
+        return {
+            "req_id": self.req_id,
+            "prompt_len": int(self.prompt.shape[0]),
+            "new_tokens": len(self.generated),
+            "queue_wait_s": ((self.t_admitted or done) - self.t_submit),
+            "ttft_s": ttft,
+            "decode_tokens_per_s": (
+                (len(self.generated) - 1) / decode_s
+                if decode_s and len(self.generated) > 1 else None),
+            "preemptions": self.preemptions,
+        }
+
+
+class Scheduler:
+    """Admission queue + running set over a :class:`KVPager`.
+
+    The engine drives it:  ``finish()``/``cancel()`` retire requests,
+    ``admit()`` returns this step's prefill batch, ``grow()`` reserves
+    decode blocks (preempting on OOM), ``running`` is the decode batch.
+    """
+
+    def __init__(self, pager: KVPager, *, max_active: int,
+                 prefill_token_budget: int,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if max_active < 1:
+            raise ValueError("max_active must be >= 1")
+        self.pager = pager
+        self.max_active = max_active
+        self.prefill_token_budget = max(1, prefill_token_budget)
+        self.waiting: deque[Request] = deque()
+        self.running: list[Request] = []
+        #: requests that can never run (prompt exceeds the whole pool) —
+        #: the engine drains these and fails their futures; leaving them
+        #: queued would livelock admission behind an unfillable head.
+        self.failed: list[tuple[Request, Exception]] = []
+        self._clock = clock
+
+    def _fits_pool_at_all(self, n_tokens: int) -> bool:
+        return (self.pager.cache.blocks_for(n_tokens + 1)
+                <= self.pager.cache.num_blocks - 1)
+
+    def _fail(self, req: Request, why: str) -> None:
+        req.state = RequestState.CANCELLED
+        req.t_finished = self._clock()
+        self.failed.append((req, OutOfBlocks(why)))
+
+    # -- queue surface ---------------------------------------------------
+    def submit(self, req: Request) -> None:
+        req.t_submit = req.t_submit or self._clock()
+        req.state = RequestState.WAITING
+        self.waiting.append(req)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # -- per-step phases -------------------------------------------------
+    def finish(self, req: Request) -> None:
+        req.state = RequestState.FINISHED
+        req.t_finished = self._clock()
+        self.running.remove(req)
+        self.pager.release(req.req_id)
+
+    def cancel(self, req: Request) -> None:
+        req.state = RequestState.CANCELLED
+        req.t_finished = self._clock()
+        if req in self.running:
+            self.running.remove(req)
+            self.pager.release(req.req_id)
+        elif req in self.waiting:
+            self.waiting.remove(req)
+
+    def admit(self) -> list[Request]:
+        """Admit waiting requests in FIFO order until the active-slot cap,
+        block supply, or the prefill token budget stops the step.  Each
+        admitted request gets its blocks allocated here (prompt + 1 slot
+        for the token prefill emits)."""
+        admitted: list[Request] = []
+        budget = self.prefill_token_budget
+        while self.waiting and len(self.running) < self.max_active:
+            req = self.waiting[0]
+            prefill = req.prefill_tokens if req.prefill_tokens is not None \
+                else req.prompt
+            n = int(prefill.shape[0])
+            if not self._fits_pool_at_all(n):
+                # Can never fit even in an empty pool: fail it rather
+                # than livelock the strictly-FIFO queue behind it.
+                self.waiting.popleft()
+                self._fail(req, f"request {req.req_id} needs "
+                           f"{self.pager.cache.blocks_for(n + 1)} blocks "
+                           f"for its {n}-token prefill; the pool only has "
+                           f"{self.pager.cache.num_blocks - 1}")
+                continue
+            if admitted and n > budget:
+                break                    # budget spent; strictly FIFO
+            if not self.pager.can_allocate(n + 1):
+                break                    # no head-of-line bypass
+            self.waiting.popleft()
+            req.prefill_tokens = np.asarray(prefill, np.int32)
+            self.pager.allocate(req.req_id, n + 1)
+            req.context_len = n
+            req.state = RequestState.RUNNING
+            req.t_admitted = req.t_admitted or self._clock()
+            self.running.append(req)
+            admitted.append(req)
+            budget -= n
+            if budget <= 0:
+                break
+        return admitted
+
+    def grow(self, req: Request) -> None:
+        """Reserve pool space for ``req``'s next position, preempting the
+        youngest OTHER running request until the allocation fits."""
+        while True:
+            try:
+                self.pager.extend(req.req_id, req.context_len + 1)
+                return
+            except OutOfBlocks:
+                victim = self._youngest_other(req)
+                if victim is None:
+                    raise OutOfBlocks(
+                        f"pool too small for request {req.req_id} alone "
+                        f"(context {req.context_len})")
+                self.preempt(victim)
+
+    def preempt(self, req: Request) -> None:
+        """Evict a RUNNING request back to the queue front.  Its generated
+        tokens fold into the prefill prompt, so on re-admission it
+        re-prefills once and continues exactly where it stopped (greedy
+        decode is deterministic)."""
+        self.running.remove(req)
+        self.pager.release(req.req_id)
+        req.prefill_tokens = np.concatenate(
+            [req.prompt, np.asarray(req.generated, np.int32)])
+        req.context_len = 0
+        req.state = RequestState.WAITING
+        req.preemptions += 1
+        self.waiting.appendleft(req)
+
+    def _youngest_other(self, keep: Request) -> Optional[Request]:
+        for req in reversed(self.running):
+            if req is not keep:
+                return req
+        return None

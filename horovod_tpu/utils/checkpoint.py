@@ -88,7 +88,11 @@ class Checkpointer:
         if target is not None:
             return self._mgr.restore(
                 step, args=ocp.args.StandardRestore(target))
-        return self._mgr.restore(step)
+        # Targetless restore still goes through StandardRestore: a FRESH
+        # manager (different instance than the saver's) has no handler
+        # registered for the saved item, and older orbax (0.7.x) refuses
+        # to infer one from the checkpoint alone.
+        return self._mgr.restore(step, args=ocp.args.StandardRestore())
 
     def all_steps(self) -> list[int]:
         return list(self._mgr.all_steps())

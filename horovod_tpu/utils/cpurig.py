@@ -27,3 +27,10 @@ def force_cpu_platform(n_devices: int = 1) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # Partitionable threefry (defaults False on 0.4.x): jitted init with
+    # sharded out_shardings must draw the same values as replicated init,
+    # or every mesh-vs-dp oracle test drifts ~0.5%.
+    try:
+        jax.config.update("jax_threefry_partitionable", True)
+    except Exception:  # pragma: no cover - removed on future jax
+        pass

@@ -47,3 +47,18 @@ def test_tf_keras_bert_pretrain_example():
          "--epochs", "1", "--samples", "16", "--batch-size", "8"])
     assert res.returncode == 0, res.stdout + res.stderr
     assert "DONE bert" in res.stdout
+
+
+@pytest.mark.integration
+def test_llama_serve_example():
+    """Single-process serving example: continuous batching end to end."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "llama_serve.py"),
+         "--requests", "3", "--max-active", "2"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "per-request results" in res.stdout
+    assert res.stdout.count("ttft") >= 3

@@ -13,6 +13,7 @@ import numpy as np
 import optax
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
+from horovod_tpu.jaxcompat import leaves_with_path
 
 from horovod_tpu.models import llama
 from horovod_tpu.parallel import MeshConfig, build_mesh
@@ -218,9 +219,9 @@ def test_flash_model_path_matches_dense_on_mesh():
     loss_d, grads_d = loss_and_grads(False)
     np.testing.assert_allclose(loss_f, loss_d, rtol=1e-5)
     flat_f = {jax.tree_util.keystr(k): v
-              for k, v in jax.tree.leaves_with_path(grads_f)}
+              for k, v in leaves_with_path(grads_f)}
     flat_d = {jax.tree_util.keystr(k): v
-              for k, v in jax.tree.leaves_with_path(grads_d)}
+              for k, v in leaves_with_path(grads_d)}
     assert flat_f.keys() == flat_d.keys()
     for key in flat_f:
         np.testing.assert_allclose(
@@ -358,9 +359,9 @@ def test_pp_flash_attention_matches_dense():
     loss_d, grads_d = loss_and_grads(False)
     np.testing.assert_allclose(loss_f, loss_d, rtol=1e-5)
     flat_f = {jax.tree_util.keystr(k): v
-              for k, v in jax.tree.leaves_with_path(grads_f)}
+              for k, v in leaves_with_path(grads_f)}
     flat_d = {jax.tree_util.keystr(k): v
-              for k, v in jax.tree.leaves_with_path(grads_d)}
+              for k, v in leaves_with_path(grads_d)}
     assert flat_f.keys() == flat_d.keys()
     for key in flat_f:
         np.testing.assert_allclose(

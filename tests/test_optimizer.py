@@ -11,7 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import lax, shard_map
+from jax import lax
+from horovod_tpu.jaxcompat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd

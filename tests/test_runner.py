@@ -361,7 +361,11 @@ def test_hvdrun_elastic_checkpoint_world_size_circle(tmp_path):
                         f"localhost:2\n127.0.0.1:2\n{my_ip}:2\n")
                     grown = True
             time.sleep(0.5)
-        out, _ = proc.communicate(timeout=300)
+        # 600s: the grow/shrink circle spawns 4 workers with fresh jax
+        # compiles each resize; on a 2-core rig running right after the
+        # full unit stage, 300s was observed marginal (it passes in ~90s
+        # standalone) — the generous bound still catches real hangs.
+        out, _ = proc.communicate(timeout=600)
     finally:
         if proc.poll() is None:
             proc.kill()

@@ -1,0 +1,406 @@
+"""serving/: paged KV cache, continuous-batching scheduler, engine.
+
+Deterministic CPU tests.  The load-bearing assertion is greedy-token
+parity: the engine must reproduce batch ``generate()``'s tokens exactly —
+same model math, different cache placement — on same-length batches,
+mixed-length workloads, under preemption pressure, through the Pallas
+paged kernel, and on a dp/tp mesh.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import serving
+from horovod_tpu.models import llama
+from horovod_tpu.parallel import MeshConfig, build_mesh
+from horovod_tpu.serving.kv_pager import (KVPager, OutOfBlocks,
+                                          PagedKVCache, gather_blocks)
+from horovod_tpu.serving.scheduler import Request, Scheduler
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.LlamaConfig.tiny()            # v256 d64 L2 H4 KV2 fp32
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _prompts(rng, lens):
+    return [rng.randint(0, 256, size=(n,)).astype(np.int32) for n in lens]
+
+
+def _generate_oracle(params, cfg, prompt, max_new):
+    return np.asarray(llama.generate(
+        params, jnp.asarray(prompt[None]), cfg, max_new_tokens=max_new))[0]
+
+
+# ---------------------------------------------------------------------------
+# pager
+# ---------------------------------------------------------------------------
+
+def _pager(num_blocks=8, block_size=4):
+    return KVPager(PagedKVCache(n_layers=2, num_blocks=num_blocks,
+                                block_size=block_size, kv_heads=2,
+                                head_dim=8))
+
+
+def test_pager_allocate_free_invariants():
+    p = _pager()
+    t1 = p.allocate(1, 7)             # 2 blocks
+    t2 = p.allocate(2, 9)             # 3 blocks
+    assert len(t1) == 2 and len(t2) == 3
+    assert 0 not in t1 + t2, "scratch block 0 must never be handed out"
+    assert len(set(t1) & set(t2)) == 0, "no block owned twice"
+    p.check_invariants()
+    assert p.free_blocks == 7 - 5
+    p.release(1)
+    assert p.free_blocks == 4
+    p.check_invariants()
+    # freed blocks are re-usable
+    t3 = p.allocate(3, 16)            # 4 blocks
+    assert set(t3) & set(t1), "released blocks should be reused"
+    p.check_invariants()
+
+
+def test_pager_oom_and_errors():
+    p = _pager(num_blocks=4)          # 3 usable
+    p.allocate(1, 8)                  # 2 blocks
+    with pytest.raises(OutOfBlocks):
+        p.allocate(2, 8)              # needs 2, only 1 free
+    # failed allocation must not leak state
+    p.check_invariants()
+    assert p.free_blocks == 1
+    with pytest.raises(ValueError):
+        p.allocate(1, 4)              # duplicate id
+    with pytest.raises(KeyError):
+        p.release(99)                 # foreign free
+    p.release(1)
+    with pytest.raises(KeyError):
+        p.release(1)                  # double free
+    p.check_invariants()
+
+
+def test_pager_extend_and_table_matrix():
+    p = _pager()
+    p.allocate(1, 4)                  # 1 block
+    tbl = p.extend(1, 5)              # crosses into block 2
+    assert len(tbl) == 2
+    assert p.extend(1, 6) == tbl      # no growth needed
+    m = p.table_matrix([1, -1], 4)
+    assert m.shape == (2, 4)
+    assert list(m[0][:2]) == tbl and list(m[0][2:]) == [0, 0]
+    assert list(m[1]) == [0, 0, 0, 0], "inactive rows are all-scratch"
+
+
+# ---------------------------------------------------------------------------
+# scheduler (host-only: no jax)
+# ---------------------------------------------------------------------------
+
+def _req(i, n, max_new=4):
+    return Request(req_id=i, prompt=np.arange(n, dtype=np.int32),
+                   max_new_tokens=max_new)
+
+
+def test_scheduler_fifo_admission_token_budget():
+    p = _pager(num_blocks=64, block_size=4)
+    s = Scheduler(p, max_active=8, prefill_token_budget=20)
+    for i, n in enumerate([16, 16, 16, 4]):
+        s.submit(_req(i, n))
+    first = [r.req_id for r in s.admit()]
+    # 16 + 16 exceeds the budget after the first; strict FIFO means the
+    # short prompt 3 must NOT jump the queue.
+    assert first == [0], f"budget admission broke FIFO: {first}"
+    assert [r.req_id for r in s.admit()] == [1]
+
+
+def test_scheduler_single_overbudget_prompt_still_admitted():
+    p = _pager(num_blocks=64, block_size=4)
+    s = Scheduler(p, max_active=4, prefill_token_budget=8)
+    s.submit(_req(0, 100))            # alone and over budget
+    assert [r.req_id for r in s.admit()] == [0]
+
+
+def test_scheduler_blocks_gate_admission_fifo():
+    p = _pager(num_blocks=8, block_size=4)   # 7 usable
+    s = Scheduler(p, max_active=4, prefill_token_budget=1000)
+    s.submit(_req(0, 20))             # needs 6 blocks (20+1 tokens)
+    s.submit(_req(1, 4))              # would fit, but FIFO holds it back
+    assert [r.req_id for r in s.admit()] == [0]
+    assert [r.req_id for r in s.admit()] == [], \
+        "head-of-line request must not be bypassed"
+    s.finish(s.running[0])
+    assert [r.req_id for r in s.admit()] == [1]
+
+
+def test_scheduler_preemption_requeues_with_progress():
+    p = _pager(num_blocks=8, block_size=4)   # 7 usable
+    s = Scheduler(p, max_active=2, prefill_token_budget=1000)
+    s.submit(_req(0, 8, max_new=20))
+    s.submit(_req(1, 8, max_new=20))
+    admitted = s.admit()
+    assert len(admitted) == 2         # 3 blocks each (8+1 tokens)
+    a, b = admitted
+    a.generated = [7, 8]
+    a.context_len = 10
+    b.generated = [9]
+    b.context_len = 9
+    # grow a until the pool forces preemption of b (the youngest other)
+    for n in range(11, 24):
+        s.grow(a)
+        a.context_len = n
+    assert b.state.value == "waiting" and b.preemptions == 1
+    assert s.waiting[0] is b, "preempted request re-queues at the FRONT"
+    # generated tokens folded into the re-prefill prompt
+    assert list(b.prefill_tokens) == list(b.prompt) + [9]
+
+
+# ---------------------------------------------------------------------------
+# engine vs generate(): greedy-token parity
+# ---------------------------------------------------------------------------
+
+def test_engine_matches_generate_same_length_batch(tiny):
+    cfg, params = tiny
+    rng = np.random.RandomState(1)
+    P, M = 8, 6
+    prompts = rng.randint(0, cfg.vocab_size, size=(3, P)).astype(np.int32)
+    ref = np.asarray(llama.generate(
+        params, jnp.asarray(prompts), cfg, max_new_tokens=M))
+    sess = serving.serve(params, cfg, block_size=4, num_blocks=64,
+                         max_active=4)
+    futs = [sess.submit(p, M) for p in prompts]
+    sess.drain()
+    for i, f in enumerate(futs):
+        assert list(f.result().full_sequence) == list(ref[i]), \
+            f"token mismatch on request {i}"
+
+
+def test_engine_matches_generate_mixed_lengths(tiny):
+    cfg, params = tiny
+    rng = np.random.RandomState(2)
+    lens = [5, 11, 3, 16, 9]
+    mx = [4, 7, 12, 3, 6]
+    prompts = _prompts(rng, lens)
+    sess = serving.serve(params, cfg, block_size=4, num_blocks=64,
+                         max_active=3)
+    futs = [sess.submit(p, m) for p, m in zip(prompts, mx)]
+    sess.drain()
+    for i, f in enumerate(futs):
+        ref = _generate_oracle(params, cfg, prompts[i], mx[i])
+        assert list(f.result().full_sequence) == list(ref), \
+            f"token mismatch on request {i} (len {lens[i]})"
+
+
+def test_engine_parity_under_preemption_pressure(tiny):
+    """A pool too small for the whole workload forces preemptions; the
+    re-prefilled continuation must still match generate() exactly."""
+    cfg, params = tiny
+    rng = np.random.RandomState(3)
+    lens = [6, 6, 6]
+    mx = [10, 10, 10]
+    prompts = _prompts(rng, lens)
+    # 11 usable blocks of 2 = 22 token slots; 3 requests need 16+ each.
+    sess = serving.serve(params, cfg, block_size=2, num_blocks=12,
+                         max_active=3)
+    futs = [sess.submit(p, m) for p, m in zip(prompts, mx)]
+    sess.drain()
+    preemptions = 0
+    for i, f in enumerate(futs):
+        res = f.result()
+        preemptions += res.metrics["preemptions"]
+        ref = _generate_oracle(params, cfg, prompts[i], mx[i])
+        assert list(res.full_sequence) == list(ref), \
+            f"token mismatch on request {i} after preemption"
+    assert preemptions > 0, "pool was sized to force preemption"
+
+
+def test_engine_bucketed_prefill_matches_exact(tiny):
+    """Right-padded bucketed prefill must emit the same tokens as
+    exact-length compiles (causality makes the padded tail inert)."""
+    cfg, params = tiny
+    rng = np.random.RandomState(4)
+    lens = [3, 5, 9]
+    prompts = _prompts(rng, lens)
+    sess = serving.serve(params, cfg, block_size=4, num_blocks=64,
+                         max_active=3, prefill_buckets=(8, 16))
+    futs = [sess.submit(p, 5) for p in prompts]
+    sess.drain()
+    for i, f in enumerate(futs):
+        ref = _generate_oracle(params, cfg, prompts[i], 5)
+        assert list(f.result().full_sequence) == list(ref)
+
+
+def test_engine_paged_flash_kernel_mode(tiny):
+    """use_flash="interpret" routes decode attention through the Pallas
+    paged kernel (scalar-prefetch block tables); tokens must match the
+    XLA gather path bit for bit."""
+    cfg, params = tiny
+    rng = np.random.RandomState(5)
+    prompts = _prompts(rng, [6, 10])
+    sess = serving.serve(params, cfg, block_size=8, num_blocks=32,
+                         max_active=2, use_flash="interpret")
+    futs = [sess.submit(p, 6) for p in prompts]
+    sess.drain()
+    for i, f in enumerate(futs):
+        ref = _generate_oracle(params, cfg, prompts[i], 6)
+        assert list(f.result().full_sequence) == list(ref)
+
+
+def test_paged_attention_kernel_vs_gather_oracle():
+    from horovod_tpu.models.llama import _cached_attend
+    from horovod_tpu.ops import flash_attention as FA
+    rng = np.random.RandomState(0)
+    B, H, KV, Dh, NB, BS, C = 3, 8, 2, 64, 16, 8, 4
+    q = jnp.asarray(rng.randn(B, H, Dh), jnp.float32)
+    kp = jnp.asarray(rng.randn(NB, BS, KV, Dh), jnp.float32)
+    vp = jnp.asarray(rng.randn(NB, BS, KV, Dh), jnp.float32)
+    tables = jnp.asarray(
+        rng.choice(np.arange(1, NB), size=(B * C,),
+                   replace=False).reshape(B, C), jnp.int32)
+    lengths = jnp.asarray([5, 17, 32], jnp.int32)
+    out = FA.paged_attention(q, kp, vp, tables, lengths, interpret=True)
+    keys, vals = gather_blocks(kp, tables), gather_blocks(vp, tables)
+    mask = (jnp.arange(C * BS)[None, :] < lengths[:, None])[:, None, :]
+    ref = _cached_attend(q[:, None], keys, vals, mask,
+                         1.0 / np.sqrt(Dh))[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_engine_on_mesh_matches_generate(tiny):
+    """dp=4/tp=2 mesh: pool kv_heads over tp (never replicated), decode
+    batch over dp — tokens must match the plain single-device engine and
+    generate()."""
+    cfg, params = tiny
+    mesh = build_mesh(MeshConfig(dp=4, tp=2))
+    params_s = jax.device_put(params, llama.param_shardings(cfg, mesh))
+    rng = np.random.RandomState(6)
+    prompts = _prompts(rng, [7, 4, 12, 9])
+    sess = serving.serve(params_s, cfg, mesh=mesh, block_size=4,
+                         num_blocks=64, max_active=4)
+    futs = [sess.submit(p, 5) for p in prompts]
+    sess.drain()
+    for i, f in enumerate(futs):
+        ref = _generate_oracle(params, cfg, prompts[i], 5)
+        assert list(f.result().full_sequence) == list(ref), \
+            f"mesh token mismatch on request {i}"
+
+
+# ---------------------------------------------------------------------------
+# streaming, metrics, timeline
+# ---------------------------------------------------------------------------
+
+def test_streaming_callback_ordering(tiny):
+    cfg, params = tiny
+    rng = np.random.RandomState(7)
+    prompts = _prompts(rng, [4, 8])
+    events: list[tuple[int, int]] = []
+    sess = serving.serve(params, cfg, block_size=4, num_blocks=64,
+                         max_active=2)
+    futs = [sess.submit(p, 6, stream_cb=lambda rid, tok:
+                        events.append((rid, tok))) for p in prompts]
+    sess.drain()
+    for f in futs:
+        res = f.result()
+        streamed = [t for rid, t in events if rid == res.req_id]
+        assert streamed == res.tokens, \
+            "per-request stream must be the token sequence, in order"
+    # interleaving property: each request's events appear in generation
+    # order even when interleaved with the other request's
+    first_positions = {}
+    for i, (rid, _) in enumerate(events):
+        first_positions.setdefault(rid, i)
+    assert len(first_positions) == 2
+
+
+def test_metrics_and_timeline_spans(tiny, tmp_path):
+    from horovod_tpu.utils.timeline import Timeline
+    cfg, params = tiny
+    rng = np.random.RandomState(8)
+    path = str(tmp_path / "serving_timeline.json")
+    sess = serving.serve(params, cfg, block_size=4, num_blocks=64,
+                         max_active=2, timeline=Timeline(path))
+    fut = sess.submit(_prompts(rng, [6])[0], 4)
+    sess.drain()
+    m = fut.result().metrics
+    assert m["new_tokens"] == 4
+    assert m["queue_wait_s"] >= 0
+    assert m["ttft_s"] is not None and m["ttft_s"] >= 0
+    assert m["decode_tokens_per_s"] is None or m["decode_tokens_per_s"] > 0
+    sess.close()
+    text = open(path).read()
+    assert "QUEUE" in text and "DECODE" in text and "req0" in text
+
+
+def test_submit_validation(tiny):
+    cfg, params = tiny
+    sess = serving.serve(params, cfg, block_size=4, num_blocks=8,
+                         max_active=1)
+    with pytest.raises(ValueError, match="empty"):
+        sess.submit(np.zeros((0,), np.int32), 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        sess.submit(np.arange(4, dtype=np.int32), 0)
+
+
+def test_submit_rejects_prompt_larger_than_pool(tiny):
+    """An unfillable prompt must be rejected up front: at the head of the
+    strictly-FIFO queue it would otherwise livelock admission forever."""
+    cfg, params = tiny
+    sess = serving.serve(params, cfg, block_size=4, num_blocks=8,
+                         max_active=2)                 # 7 usable = 28 slots
+    with pytest.raises(ValueError, match="blocks"):
+        sess.submit(np.arange(40, dtype=np.int32) % 100, 4)
+    # and a fitting request behind the rejection still works
+    fut = sess.submit(np.arange(6, dtype=np.int32), 2)
+    sess.drain()
+    assert len(fut.result().tokens) == 2
+
+
+def test_scheduler_fails_unfittable_requeued_request():
+    """A preempted request whose folded-in progress no longer fits the
+    pool must be FAILED (drained via engine.pop_failed), not left to
+    livelock the FIFO head."""
+    p = _pager(num_blocks=4, block_size=4)             # 3 usable = 12 slots
+    s = Scheduler(p, max_active=2, prefill_token_budget=1000)
+    r = _req(0, 4, max_new=30)
+    s.submit(r)
+    r.prefill_tokens = np.arange(20, dtype=np.int32)   # preemption fold
+    assert s.admit() == []
+    assert s.waiting == deque() or not s.waiting
+    assert len(s.failed) == 1 and s.failed[0][0] is r
+    assert isinstance(s.failed[0][1], OutOfBlocks)
+
+
+def test_background_thread_failure_sets_future_exception(tiny):
+    """A request that outgrows the pool while running ALONE raises
+    OutOfBlocks in the engine; the background thread must surface it on
+    the pending future instead of dying silently."""
+    cfg, params = tiny
+    # 3 usable blocks = 12 token slots; prompt 4 + max_new 12 overflows.
+    sess = serving.serve(params, cfg, block_size=4, num_blocks=4,
+                         max_active=1)
+    fut = sess.submit(np.arange(4, dtype=np.int32), 12)
+    sess.start()
+    with pytest.raises(OutOfBlocks):
+        fut.result(timeout=120)
+    sess.close()
+
+
+def test_eos_token_stops_early(tiny):
+    cfg, params = tiny
+    rng = np.random.RandomState(9)
+    prompt = _prompts(rng, [6])[0]
+    ref = _generate_oracle(params, cfg, prompt, 8)
+    eos = int(ref[len(prompt) + 2])   # the 3rd generated token
+    sess = serving.serve(params, cfg, block_size=4, num_blocks=64,
+                         max_active=1)
+    fut = sess.submit(prompt, 8, eos_token=eos)
+    sess.drain()
+    res = fut.result()
+    assert res.tokens == list(ref[len(prompt):len(prompt) + 3]), \
+        "generation must stop AT the eos token"
