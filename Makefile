@@ -10,6 +10,7 @@ ci: native
 	$(PY) -c "import horovod_tpu, horovod_tpu.torch, horovod_tpu.tensorflow, \
 horovod_tpu.keras, horovod_tpu.elastic, horovod_tpu.spark, horovod_tpu.ray, \
 horovod_tpu.serving"
+	$(PY) -m horovod_tpu.obs.smoke
 	$(PY) benchmarks/baseline_table.py --check
 	$(PY) -m pytest tests -q -x --ignore=tests/test_runner.py
 	$(PY) -m pytest tests/test_runner.py -q -x

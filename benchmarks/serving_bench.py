@@ -17,6 +17,14 @@ requested tokens / wall time), p50/p99 TTFT, and the speedup over the
 baseline (which, batch-synchronous, gives every request in a cohort the
 same TTFT = the cohort's full wall time, and makes later cohorts wait).
 
+Also reported: **instrumentation overhead** — closed-load tok/s with the
+metrics registry enabled vs ``obs.REGISTRY.disable()``d (budget: <2%).
+Setting ``HVDTPU_METRICS_PORT`` (or ``HOROVOD_TPU_METRICS_PORT``) brings
+up the Prometheus endpoint for the duration of the run, and the bench
+fires a few engine-path collectives first, so one
+``curl :$PORT/metrics`` mid-run shows collective-bytes, TTFT-histogram
+and KV-utilization series together (docs/observability.md walkthrough).
+
 Run: ``python benchmarks/serving_bench.py [--requests N] [--quick]``
 Appends a ``serving_continuous_batching_cpu`` record to
 ``benchmarks/measured.jsonl`` (regenerate BASELINE.md with
@@ -143,7 +151,21 @@ def main() -> None:
     force_cpu_platform(1)
     import jax
 
+    import horovod_tpu as hvd
+    from horovod_tpu import obs
     from horovod_tpu.models import llama
+
+    if obs.server._singleton is not None:
+        print(f"[obs] metrics endpoint on "
+              f":{obs.server._singleton.port}/metrics")
+    # Light up the collective-plane series too (engine-path allreduces),
+    # so a scrape during this bench covers all three instrumented
+    # subsystems: engine, serving, KV pool.
+    hvd.init()
+    for i in range(4):
+        hvd.synchronize(hvd.allreduce_async(
+            hvd.per_rank([np.ones((1024,), np.float32)]),
+            name=f"bench.obs_heartbeat.{i}"))
 
     cfg = llama.LlamaConfig.tiny(
         vocab_size=512, d_model=128, n_layers=4, n_heads=8, n_kv_heads=4,
@@ -182,6 +204,20 @@ def main() -> None:
               f"{tok / wall:.1f} tok/s  p50 TTFT {points[-1]['p50_ttft_s']}s"
               f"  p99 {points[-1]['p99_ttft_s']}s")
 
+    # Instrumentation overhead: back-to-back closed-load passes with the
+    # registry recording vs disabled (budget <2% — the obs acceptance bar).
+    tok_on, wall_on, _ = run_engine(sess, reqs, 0.0)
+    obs.REGISTRY.disable()
+    try:
+        tok_off, wall_off, _ = run_engine(sess, reqs, 0.0)
+    finally:
+        obs.REGISTRY.enable()
+    rate_on, rate_off = tok_on / wall_on, tok_off / wall_off
+    overhead_pct = (rate_off - rate_on) / rate_off * 100.0
+    print(f"[obs overhead] metrics on {rate_on:.1f} tok/s vs off "
+          f"{rate_off:.1f} tok/s = {overhead_pct:+.2f}% "
+          f"({'within' if overhead_pct < 2.0 else 'OVER'} the 2% budget)")
+
     base_rate = base_tok / base_s
     closed = points[0]["tokens_per_sec_per_chip"]
     speedup = closed / base_rate
@@ -207,6 +243,7 @@ def main() -> None:
             "block_size": block_size,
             "num_blocks": num_blocks,
             "max_active": max_active,
+            "metrics_overhead_pct": round(overhead_pct, 3),
             "d_model": cfg.d_model,
             "n_layers": cfg.n_layers,
             "device_kind": "cpu",

@@ -36,6 +36,7 @@ import itertools
 from typing import Any, Optional, Sequence
 
 from . import config  # noqa: F401
+from . import obs  # noqa: F401  (also arms the env-gated metrics endpoint)
 from .context import (  # noqa: F401
     init,
     shutdown,
@@ -556,6 +557,37 @@ def join(timeout: Optional[float] = None) -> int:
         return state.engine.join(timeout=timeout)
     barrier()
     return size() - 1
+
+
+# ---------------------------------------------------------------------------
+# Telemetry (horovod_tpu.obs; beyond the reference, whose surface stops at
+# the timeline).
+# ---------------------------------------------------------------------------
+
+def metrics(fmt: str = "dict"):
+    """Snapshot of the process-wide metrics registry.
+
+    Every runtime layer (collective engine, serving, elastic, autotune)
+    reports counters/gauges/histograms into :data:`horovod_tpu.obs.REGISTRY`;
+    this returns them as
+
+    - ``fmt="dict"`` — plain-data snapshot (list of metric families);
+    - ``fmt="json"`` — the ``/metrics.json`` endpoint's JSON string;
+    - ``fmt="prometheus"`` — Prometheus text exposition, byte-identical
+      to ``GET :$HVDTPU_METRICS_PORT/metrics``.
+
+    Works before/without ``init()`` — the registry is process-wide, not
+    part of engine state.
+    """
+    snap = obs.REGISTRY.snapshot()
+    if fmt == "dict":
+        return snap
+    if fmt == "json":
+        return obs.export.to_json(snap)
+    if fmt == "prometheus":
+        return obs.export.to_prometheus(snap)
+    raise ValueError(
+        f"fmt must be 'dict', 'json' or 'prometheus', got {fmt!r}")
 
 
 # ---------------------------------------------------------------------------

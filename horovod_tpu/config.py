@@ -8,9 +8,10 @@ model but with a single dataclass as the source of truth: every knob is
 declared once here, and the env parser, CLI flags (``horovod_tpu/runner``)
 and YAML loader are generated from this table.
 
-Env vars are read with both the ``HVDTPU_`` prefix (native) and the
-``HOROVOD_`` prefix (compatibility with reference deployments); ``HVDTPU_``
-wins when both are set.
+Env vars are read with the ``HVDTPU_`` prefix (native), the ``HOROVOD_TPU_``
+prefix (long-form native) and the ``HOROVOD_`` prefix (compatibility with
+reference deployments); the first prefix in that order wins when several
+are set.
 """
 
 from __future__ import annotations
@@ -75,6 +76,10 @@ class Config:
     timeline: Optional[str] = None  # path for Chrome-trace JSON
     timeline_mark_cycles: bool = False
 
+    # --- metrics exposition (horovod_tpu.obs; beyond the reference) ---
+    # TCP port for the Prometheus/JSON pull endpoint; None = no server.
+    metrics_port: Optional[int] = None
+
     # --- stall inspector († stall_inspector.cc) ---
     stall_check: bool = True
     stall_warning_time_s: float = 60.0
@@ -130,6 +135,7 @@ _ENV_TABLE = [
     ("autotune_steps_per_sample", "AUTOTUNE_STEPS_PER_SAMPLE", int),
     ("timeline", "TIMELINE", str),
     ("timeline_mark_cycles", "TIMELINE_MARK_CYCLES", _parse_bool),
+    ("metrics_port", "METRICS_PORT", int),
     ("stall_check", "STALL_CHECK_DISABLE", lambda v: not _parse_bool(v)),
     ("stall_warning_time_s", "STALL_CHECK_TIME_SECONDS", float),
     ("stall_shutdown_time_s", "STALL_SHUTDOWN_TIME_SECONDS", float),
@@ -154,7 +160,7 @@ _ENV_TABLE = [
 
 _FIELD_PARSERS = {field: parser for field, _, parser in _ENV_TABLE}
 
-_PREFIXES = ("HVDTPU_", "HOROVOD_")
+_PREFIXES = ("HVDTPU_", "HOROVOD_TPU_", "HOROVOD_")
 
 
 def _env_lookup(suffix: str) -> Optional[str]:

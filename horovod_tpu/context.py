@@ -205,6 +205,26 @@ def init(
         from .ops.process_sets import ProcessSetTable
         _state.process_set_table = ProcessSetTable(_state)
 
+        # Metrics pull endpoint.  start() is first-call-wins process-wide:
+        # if the env autostart in horovod_tpu.obs already bound a port,
+        # a conflicting programmatic knob cannot rebind — say so instead
+        # of silently serving on the old port.
+        if cfg.metrics_port is not None:
+            from .obs import server as obs_server
+            try:
+                srv = obs_server.start(cfg.metrics_port)
+                if srv.port != cfg.metrics_port:
+                    log.warning(
+                        "metrics endpoint already on port %d (env "
+                        "autostart); config metrics_port=%d ignored",
+                        srv.port, cfg.metrics_port)
+            except OSError as e:
+                # Every worker of a multi-process job sees the same knob;
+                # only one per host can bind it.  Telemetry is optional —
+                # init must not fail over it.
+                log.warning("metrics endpoint not started on port %d: %s",
+                            cfg.metrics_port, e)
+
         _state.initialized = True
         log.info(
             "horovod_tpu initialized: size=%d local_size=%d rank=%d backend=%s",

@@ -14,10 +14,16 @@ import functools
 import os
 from typing import Any, Callable, Optional
 
+from ..obs import REGISTRY as _obs
 from ..ops.engine import HorovodInternalError
 from ..utils import logging as hvd_logging
 
 log = hvd_logging.get_logger()
+
+_m_interrupts = _obs.counter(
+    "hvd_elastic_interrupts_total",
+    "elastic control-flow interrupts seen by the worker loop",
+    ("kind",))
 
 _EPOCH_KEY = "elastic/membership_epoch"
 
@@ -101,6 +107,7 @@ def run(func: Callable[..., Any]) -> Callable[..., Any]:
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError as e:
+                _m_interrupts.labels(kind="failure").inc()
                 if os.environ.get("HVDTPU_ELASTIC") == "1":
                     # Under the ElasticDriver the job — not the process —
                     # is the recovery unit (static mesh + controller in
@@ -120,6 +127,7 @@ def run(func: Callable[..., Any]) -> Callable[..., Any]:
                 _reinitialize()
                 state.restore()
             except HostsUpdatedInterrupt as e:
+                _m_interrupts.labels(kind="hosts_updated").inc()
                 if os.environ.get("HVDTPU_ELASTIC") == "1":
                     from ..runner.launch import RESTART_EXIT_CODE
                     log.info(

@@ -1,0 +1,33 @@
+"""Unified telemetry for horovod_tpu: metrics registry + exposition.
+
+The observability layer the reference never had († its surface is
+``timeline.cc`` + ``HOROVOD_LOG_LEVEL``): every runtime subsystem —
+collective engine, paged-KV serving, elastic runner, autotuner — reports
+counters/gauges/histograms into one process-wide
+:class:`~horovod_tpu.obs.registry.MetricRegistry`, readable as
+
+- ``hvd.metrics()`` (dict / JSON / Prometheus text, in-process),
+- ``GET :$HVDTPU_METRICS_PORT/metrics`` (Prometheus pull endpoint,
+  stdlib http.server; also spelled ``HOROVOD_TPU_METRICS_PORT``),
+- Timeline-v2 counter events (the same series as Chrome-trace ``"C"``
+  events next to the per-tensor spans, one Perfetto load).
+
+Stdlib-only by design; importing this package never imports jax.
+"""
+
+from . import export, server  # noqa: F401
+from .registry import (  # noqa: F401
+    Counter,
+    DEFAULT_TIME_BUCKETS,
+    Gauge,
+    Histogram,
+    MetricError,
+    MetricRegistry,
+    REGISTRY,
+    get_registry,
+)
+
+# Env-gated autostart: HVDTPU_METRICS_PORT / HOROVOD_TPU_METRICS_PORT /
+# HOROVOD_METRICS_PORT set => the pull endpoint is up as soon as anything
+# imports horovod_tpu (no-op otherwise).
+server.maybe_start_from_env()

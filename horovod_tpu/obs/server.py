@@ -1,0 +1,138 @@
+"""Stdlib HTTP pull endpoint for the metrics registry.
+
+``GET /metrics`` returns Prometheus text (content type
+``text/plain; version=0.0.4``), ``GET /metrics.json`` the JSON form —
+both snapshot the registry atomically per request.  The server is a
+daemon-threaded ``http.server`` (no extra dependency), started either
+
+- explicitly (``MetricsServer(port)`` / :func:`start`), or
+- from the environment: ``HVDTPU_METRICS_PORT`` /
+  ``HOROVOD_TPU_METRICS_PORT`` / ``HOROVOD_METRICS_PORT`` (first set
+  wins) makes ``import horovod_tpu`` and ``hvd.init()`` bring the
+  endpoint up — so ``curl :$PORT/metrics`` works during any run,
+  including the serving benchmark, without code changes.
+
+Binds all interfaces by default (a scrape endpoint); pass
+``addr="127.0.0.1"`` to keep it local.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from . import export
+from .registry import REGISTRY, MetricRegistry
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_ENV_VARS = ("HVDTPU_METRICS_PORT", "HOROVOD_TPU_METRICS_PORT",
+             "HOROVOD_METRICS_PORT")
+
+
+def _make_handler(registry: MetricRegistry):
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - http.server API
+            path = self.path.split("?", 1)[0]
+            if path in ("/metrics", "/"):
+                body = export.to_prometheus(registry.snapshot())
+                ctype = PROMETHEUS_CONTENT_TYPE
+            elif path == "/metrics.json":
+                body = export.to_json(registry.snapshot())
+                ctype = "application/json"
+            else:
+                self.send_error(404, "try /metrics or /metrics.json")
+                return
+            payload = body.encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *args):  # scrapes are not log events
+            pass
+
+    return _Handler
+
+
+class MetricsServer:
+    """One listening endpoint over one registry; ``port=0`` binds an
+    ephemeral port (read it back from ``.port``)."""
+
+    def __init__(self, port: int = 0, *, addr: str = "",
+                 registry: Optional[MetricRegistry] = None) -> None:
+        self.registry = registry or REGISTRY
+        self._httpd = ThreadingHTTPServer(
+            (addr, port), _make_handler(self.registry))
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="hvdtpu-metrics")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+_singleton: Optional[MetricsServer] = None
+_singleton_lock = threading.Lock()
+
+
+def start(port: int, *, addr: str = "") -> MetricsServer:
+    """Start (or return) the process-wide endpoint on the default
+    registry.  Idempotent: the first call wins; later calls return the
+    running server regardless of port."""
+    global _singleton
+    with _singleton_lock:
+        if _singleton is None:
+            _singleton = MetricsServer(port, addr=addr)
+            from ..utils import logging as hvd_logging
+            hvd_logging.get_logger().info(
+                "metrics endpoint listening on :%d (/metrics, "
+                "/metrics.json)", _singleton.port)
+        return _singleton
+
+
+def stop() -> None:
+    global _singleton
+    with _singleton_lock:
+        if _singleton is not None:
+            _singleton.close()
+            _singleton = None
+
+
+def maybe_start_from_env() -> Optional[MetricsServer]:
+    """Start the endpoint iff a metrics-port env var is set (no-op
+    otherwise); called at package import and from ``hvd.init()``."""
+    for var in _ENV_VARS:
+        raw = os.environ.get(var)
+        if raw:
+            try:
+                port = int(raw)
+            except ValueError:
+                from ..utils import logging as hvd_logging
+                hvd_logging.get_logger().warning(
+                    "ignoring bad %s=%r (want an integer port)", var, raw)
+                return None
+            if port <= 0:
+                # 0 disables (mirrors metrics_port=None); an ephemeral
+                # port makes no sense for a scrape target and would open
+                # an unannounced listener on every importing process.
+                return None
+            try:
+                return start(port)
+            except OSError as e:
+                # Multi-process jobs inherit the env var on every worker;
+                # only one can bind the port.  Losing the endpoint on the
+                # others must not fail `import horovod_tpu`.
+                from ..utils import logging as hvd_logging
+                hvd_logging.get_logger().warning(
+                    "metrics endpoint not started (%s=%s): %s", var, raw, e)
+                return None
+    return None

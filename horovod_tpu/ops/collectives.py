@@ -60,6 +60,16 @@ Max = ReduceOp.MAX
 Product = ReduceOp.PRODUCT
 
 
+from ..obs import REGISTRY as _obs
+
+_m_cache_hits = _obs.counter(
+    "hvd_dispatch_cache_hits_total",
+    "compiled-collective dispatch cache hits (response-cache analogue)")
+_m_cache_misses = _obs.counter(
+    "hvd_dispatch_cache_misses_total",
+    "compiled-collective dispatch cache misses (each one is an XLA build)")
+
+
 class _DispatchCache:
     """LRU table of compiled collective programs (response-cache analogue)."""
 
@@ -75,8 +85,10 @@ class _DispatchCache:
             if fn is not None:
                 self._table.move_to_end(key)
                 self.hits += 1
+                _m_cache_hits.inc()
                 return fn
             self.misses += 1
+            _m_cache_misses.inc()
         fn = builder()
         with self._lock:
             self._table[key] = fn

@@ -37,9 +37,40 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Sequence
 
 from . import collectives as C
+from ..obs import REGISTRY as _obs
 from ..utils import logging as hvd_logging
 
 log = hvd_logging.get_logger()
+
+# Engine telemetry (horovod_tpu.obs): per-collective count/byte accounting
+# is the substrate for comms optimization (Awan et al., arXiv:1810.11112)
+# the reference only exposed as a Chrome trace.
+_m_collectives = _obs.counter(
+    "hvd_collectives_total", "collectives dispatched by the engine",
+    ("verb",))
+_m_bytes = _obs.counter(
+    "hvd_collective_bytes_total",
+    "payload bytes through engine-dispatched collectives", ("verb",))
+_m_errors = _obs.counter(
+    "hvd_collective_errors_total",
+    "collectives that completed with an error", ("verb",))
+_m_fusion_batch = _obs.histogram(
+    "hvd_fusion_batch_tensors", "tensors per fused allreduce dispatch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+_m_cycle = _obs.histogram(
+    "hvd_cycle_seconds",
+    "engine cycle wall time (drain -> negotiate -> fuse -> dispatch)")
+_m_queue_depth = _obs.gauge(
+    "hvd_engine_queue_depth",
+    "entries left pending in the tensor queue after a cycle")
+
+# Pre-resolved per-verb children: the completion loop runs once per tensor
+# per cycle (the gradient-hook hot path), so keep it at one locked float
+# add per series — no labels() lookup per event.
+_VERBS = ("allreduce", "allgather", "broadcast", "alltoall", "reducescatter")
+_m_coll_v = {v: _m_collectives.labels(verb=v) for v in _VERBS}
+_m_bytes_v = {v: _m_bytes.labels(verb=v) for v in _VERBS}
+_m_errors_v = {v: _m_errors.labels(verb=v) for v in _VERBS}
 
 
 class HorovodInternalError(RuntimeError):
@@ -65,6 +96,9 @@ class TensorTableEntry:
     # Timeline phase currently open for this entry ("" | QUEUE | NEGOTIATE);
     # † timeline.cc tracks the same per-tensor lifecycle state.
     tl_phase: str = field(default="", compare=False)
+    # Timeline-v2 flow id linking this entry's QUEUE span to its DISPATCH
+    # span (0 = no flow open).
+    tl_flow: int = field(default=0, compare=False)
 
     def meta(self) -> str:
         """Serialized descriptor carried through negotiation so a joined
@@ -313,6 +347,8 @@ class CollectiveEngine:
                 # pickup; NEGOTIATE = pickup -> globally ready.
                 tl.start_activity(entry.name, "QUEUE")
                 entry.tl_phase = "QUEUE"
+                entry.tl_flow = tl.new_flow()
+                tl.flow_start(entry.name, entry.tl_flow)
             if urgent:
                 self._urgent = True
                 self._wake.notify_all()
@@ -455,6 +491,17 @@ class CollectiveEngine:
                 self._queue = deferred + self._queue
         for group in self._fuse(ready):
             self._execute_group(group, handles)
+        _m_cycle.observe(time.monotonic() - t0)
+        with self._lock:
+            depth = len(self._queue)
+        _m_queue_depth.set(depth)
+        if tl is not None and tl.enabled:
+            # Timeline v2: registry-fed counter tracks alongside the spans.
+            tl.counter("hvd.engine", {
+                "queue_depth": depth,
+                "collectives_total": _m_collectives.total(),
+                "collective_bytes_total": _m_bytes.total(),
+            })
         if join_req and outcome.all_joined:
             with self._lock:
                 self._join_requested = False
@@ -609,6 +656,10 @@ class CollectiveEngine:
                         tl.end_activity(e.name)
                     tl.start_activity(e.name, "DISPATCH")
                     e.tl_phase = "DISPATCH"
+                    if e.tl_flow:
+                        # v2 flow arrow: QUEUE span -> this DISPATCH span.
+                        tl.flow_end(e.name, e.tl_flow)
+                        e.tl_flow = 0
             # Named span in device profiles too: `jax.profiler.trace()`
             # captures show which collective a compiled program belongs
             # to, complementing the host-side Chrome timeline
@@ -622,7 +673,11 @@ class CollectiveEngine:
                 for e in group:
                     tl.end_activity(e.name)
                     e.tl_phase = ""
+            if group[0].verb == "allreduce":
+                _m_fusion_batch.observe(len(group))
             for e, r in zip(group, results):
+                _m_coll_v[e.verb].inc()
+                _m_bytes_v[e.verb].inc(self._entry_bytes(e))
                 with self._lock:
                     self._names_pending.discard(e.name)
                 handles[id(e)]._complete(result=r)
@@ -630,6 +685,10 @@ class CollectiveEngine:
             # † error Response delivered to every participating rank so all
             # raise rather than some hanging.
             for e in group:
+                # .get fallback: an unknown verb reaches this loop via the
+                # _dispatch ValueError, and the error path must never throw.
+                (_m_errors_v.get(e.verb)
+                 or _m_errors.labels(verb=e.verb)).inc()
                 with self._lock:
                     self._names_pending.discard(e.name)
                 self._tl_close(e)

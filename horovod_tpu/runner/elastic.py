@@ -22,9 +22,20 @@ import time
 from typing import Callable, List, Optional, Sequence
 
 from .hosts import HostSlots, assign_ranks, parse_hosts
+from ..obs import REGISTRY as _obs
 from ..utils import logging as hvd_logging
 
 log = hvd_logging.get_logger()
+
+_m_worker_failures = _obs.counter(
+    "hvd_elastic_worker_failures_total",
+    "worker crashes that blacklisted a host")
+_m_rendezvous_rounds = _obs.counter(
+    "hvd_elastic_rendezvous_rounds_total",
+    "job (re)launch rounds run by the elastic driver")
+_m_hosts = _obs.gauge(
+    "hvd_elastic_available_hosts",
+    "non-blacklisted hosts in the current assignment")
 
 
 class HostDiscovery:
@@ -98,6 +109,7 @@ class ElasticDriver:
         from future assignments."""
         with self._lock:
             self._blacklist.add(hostname)
+        _m_worker_failures.inc()
         log.warning("elastic: blacklisted host %s", hostname)
 
     def blacklisted(self) -> set[str]:
@@ -249,6 +261,8 @@ class ElasticDriver:
         while True:
             hosts = self.wait_for_available_slots(timeout_s=slot_timeout_s)
             epoch = self.membership_epoch
+            _m_rendezvous_rounds.inc()
+            _m_hosts.set(len(hosts))
             log.info("elastic: launching on %s (epoch %d)", hosts, epoch)
             env = dict(extra_env or {})
             env["HVDTPU_ELASTIC"] = "1"

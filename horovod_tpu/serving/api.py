@@ -6,7 +6,9 @@ request-facing surface a client sees:
 - ``submit(prompt, max_tokens) -> concurrent.futures.Future`` resolving
   to a :class:`RequestResult` (tokens + per-request metrics);
 - optional per-token streaming callbacks, invoked in emission order;
-- per-request metrics — TTFT, queue wait, decode tok/s — logged through
+- per-request metrics — TTFT, queue wait, decode tok/s — routed into the
+  process metrics registry (:mod:`horovod_tpu.obs`: TTFT/ITL histograms,
+  request/token counters), logged through
   :mod:`horovod_tpu.utils.logging` and traced as QUEUE (submit → first
   token, prefill included) → DECODE spans on
   :class:`horovod_tpu.utils.timeline.Timeline` (one timeline row per
@@ -27,12 +29,34 @@ from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
+from ..obs import REGISTRY as _obs
 from ..utils import logging as hvd_logging
 from ..utils.timeline import Timeline
 from .engine import EngineConfig, ServingEngine
 from .scheduler import Request
 
 log = hvd_logging.get_logger()
+
+# Request-level latency series (horovod_tpu.obs).  TTFT and ITL are the
+# two serving SLO primitives; queue-wait isolates the admission share of
+# TTFT so "slow prefill" and "full pool" are distinguishable in one scrape.
+_m_ttft = _obs.histogram(
+    "hvd_serving_ttft_seconds",
+    "submit -> first emitted token (queue wait + prefill)")
+_m_itl = _obs.histogram(
+    "hvd_serving_itl_seconds",
+    "inter-token latency between consecutive emissions of one request")
+_m_queue_wait = _obs.histogram(
+    "hvd_serving_queue_wait_seconds", "submit -> admission")
+_m_decode_rate = _obs.gauge(
+    "hvd_serving_decode_tokens_per_s",
+    "steady-state decode rate of the most recently finished request")
+_m_requests = _obs.counter(
+    "hvd_serving_requests_total", "requests by terminal outcome",
+    ("outcome",))
+_m_tokens = _obs.counter(
+    "hvd_serving_tokens_generated_total",
+    "tokens delivered by finished requests")
 
 
 @dataclasses.dataclass
@@ -58,6 +82,7 @@ class ServingSession:
         self.engine = engine
         self._timeline = timeline or Timeline(None)
         self._futures: dict[int, Future] = {}
+        self._t_last_emit: dict[int, float] = {}   # req_id -> last token ts
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -138,6 +163,8 @@ class ServingSession:
             failed = self.engine.pop_failed()
         for req, exc in failed:
             self._timeline.end_activity(f"req{req.req_id}")
+            self._t_last_emit.pop(req.req_id, None)
+            _m_requests.labels(outcome="failed").inc()
             fut = self._futures.pop(req.req_id, None)
             if fut is not None and not fut.done():
                 fut.set_exception(exc)
@@ -146,8 +173,14 @@ class ServingSession:
             name = f"req{req.req_id}"
             if req.t_first_token is None:
                 req.t_first_token = now
+                _m_ttft.observe(now - req.t_submit)
                 self._timeline.end_activity(name)          # QUEUE/PREFILL
                 self._timeline.start_activity(name, "DECODE")
+            else:
+                last = self._t_last_emit.get(req.req_id)
+                if last is not None:
+                    _m_itl.observe(now - last)
+            self._t_last_emit[req.req_id] = now
             if req.stream_cb is not None:
                 req.stream_cb(req.req_id, token)
             if req.state.value == "finished":
@@ -156,7 +189,17 @@ class ServingSession:
     def _resolve(self, req: Request) -> None:
         name = f"req{req.req_id}"
         self._timeline.end_activity(name)
+        self._t_last_emit.pop(req.req_id, None)
         m = req.metrics()
+        # Registry routing of the per-request metrics dict (the log line
+        # below stays — grep-ability is a feature, it is just no longer
+        # the only consumer).  TTFT/ITL were observed at emission time;
+        # the submit->admission share and the decode rate land here.
+        _m_requests.labels(outcome="finished").inc()
+        _m_tokens.inc(m["new_tokens"])
+        _m_queue_wait.observe(m["queue_wait_s"])
+        if m["decode_tokens_per_s"]:
+            _m_decode_rate.set(m["decode_tokens_per_s"])
         log.info(
             "serving req=%d prompt=%d new=%d queue_wait=%.4fs ttft=%.4fs "
             "decode_tok_s=%s preemptions=%d",

@@ -31,11 +31,28 @@ from typing import Any, Optional
 import numpy as np
 
 from ..models import llama
+from ..obs import REGISTRY as _obs
 from ..utils import logging as hvd_logging
 from .kv_pager import KVPager, PagedKVCache
 from .scheduler import Request, Scheduler
 
 log = hvd_logging.get_logger()
+
+# Serving-plane health (horovod_tpu.obs), sampled once per step():
+_m_queue_depth = _obs.gauge(
+    "hvd_serving_queue_depth", "requests waiting for admission")
+_m_occupancy = _obs.gauge(
+    "hvd_serving_batch_occupancy",
+    "active decode slots / max_active (1.0 = the compiled batch is full)")
+_m_kv_util = _obs.gauge(
+    "hvd_serving_kv_utilization",
+    "allocated pool blocks / usable blocks (block 0 is scratch)")
+_m_steps = _obs.counter(
+    "hvd_serving_steps_total", "serving rounds executed")
+_m_prefill_tokens = _obs.counter(
+    "hvd_serving_prefill_tokens_total", "prompt tokens prefilled")
+_m_decode_tokens = _obs.counter(
+    "hvd_serving_decode_tokens_total", "tokens emitted by decode ticks")
 
 
 def _bucket_pow2(n: int, floor: int = 1) -> int:
@@ -211,12 +228,26 @@ class ServingEngine:
         """One serving round; returns the (request, token) emissions."""
         emitted: list[tuple[Request, int]] = []
         self._steps += 1
+        _m_steps.inc()
         for req in self.scheduler.admit():
             self._assign_slot(req)
+            _m_prefill_tokens.inc(int(req.prefill_tokens.shape[0]))
             emitted.append((req, self._prefill_one(req)))
         if self.scheduler.running:
-            emitted.extend(self._decode_tick())
+            ticked = self._decode_tick()
+            _m_decode_tokens.inc(len(ticked))
+            emitted.extend(ticked)
+        self._sample_gauges()
         return emitted
+
+    def _sample_gauges(self) -> None:
+        """Pool/queue health after a step: queue depth, compiled-batch
+        occupancy, KV-pool utilization."""
+        _m_queue_depth.set(len(self.scheduler.waiting))
+        _m_occupancy.set(
+            len(self.scheduler.running) / self.ecfg.max_active)
+        usable = self.cache.num_blocks - 1
+        _m_kv_util.set((usable - self.pager.free_blocks) / usable)
 
     def run(self, max_steps: Optional[int] = None
             ) -> list[tuple[Request, int]]:

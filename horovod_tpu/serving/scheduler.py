@@ -33,7 +33,12 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..obs import REGISTRY as _obs
 from .kv_pager import KVPager, OutOfBlocks
+
+_m_preemptions = _obs.counter(
+    "hvd_serving_preemptions_total",
+    "running requests evicted back to the queue on pool pressure")
 
 
 class RequestState(enum.Enum):
@@ -212,6 +217,7 @@ class Scheduler:
         req.context_len = 0
         req.state = RequestState.WAITING
         req.preemptions += 1
+        _m_preemptions.inc()
         self.waiting.appendleft(req)
 
     def _youngest_other(self, keep: Request) -> Optional[Request]:

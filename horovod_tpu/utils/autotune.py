@@ -20,10 +20,21 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs import REGISTRY as _obs
+
 # Candidate grid (log2 bytes for threshold, ms for cycle time), spanning the
 # same range the reference explores.
 _THRESHOLDS = [1 << p for p in range(20, 28)]         # 1 MB .. 128 MB
 _CYCLE_TIMES = [0.5, 1.0, 2.5, 5.0, 10.0, 20.0]        # ms
+
+_m_trials = _obs.counter(
+    "hvd_autotune_trials_total", "knob configurations scored by the tuner")
+_m_score = _obs.gauge(
+    "hvd_autotune_score_bytes_per_s", "latest trial's throughput score")
+_m_threshold = _obs.gauge(
+    "hvd_autotune_fusion_threshold_bytes", "fusion threshold in effect")
+_m_cycle_ms = _obs.gauge(
+    "hvd_autotune_cycle_time_ms", "engine cycle time in effect")
 
 
 class _GP:
@@ -101,6 +112,8 @@ class Autotuner:
         t, c = self._current
         self._samples_X.append((math.log2(t), math.log2(c)))
         self._samples_y.append(score)
+        _m_trials.inc()
+        _m_score.set(score)
         self._propose_next()
 
     def _propose_next(self) -> None:
@@ -135,6 +148,8 @@ class Autotuner:
         self._current = (threshold, cycle_ms)
         self._state.config.fusion_threshold = threshold
         self._state.config.cycle_time_ms = cycle_ms
+        _m_threshold.set(threshold)
+        _m_cycle_ms.set(cycle_ms)
 
     def _log(self, msg: str) -> None:
         if not self._log_path:

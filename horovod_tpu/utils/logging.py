@@ -3,12 +3,19 @@
 
 Python's stdlib logging already provides the mechanism; this module maps the
 reference's level names (including ``trace`` and ``fatal``) onto it and
-applies the env-driven configuration.
+applies the env-driven configuration **at import**: setting
+``HVDTPU_LOG_LEVEL`` / ``HOROVOD_TPU_LOG_LEVEL`` / ``HOROVOD_LOG_LEVEL``
+(first set wins) and ``..._LOG_HIDE_TIME`` configures the logger before any
+code runs — matching the reference, where the env vars take effect at
+process start, not at ``hvd.init()``.  ``hvd.init()`` re-applies them
+through :mod:`horovod_tpu.config` (same values, so it is a no-op unless a
+``Config`` overrides programmatically).
 """
 
 from __future__ import annotations
 
 import logging
+import os
 
 TRACE = 5
 logging.addLevelName(TRACE, "TRACE")
@@ -23,6 +30,20 @@ _LEVELS = {
 }
 
 _LOGGER_NAME = "horovod_tpu"
+
+# Same precedence order as horovod_tpu.config._PREFIXES (native name wins
+# over the reference-compat one); duplicated here because config imports
+# are not allowed at logging-import time (logging is the bottom of the
+# dependency stack).
+_ENV_PREFIXES = ("HVDTPU_", "HOROVOD_TPU_", "HOROVOD_")
+
+
+def _env(suffix: str):
+    for prefix in _ENV_PREFIXES:
+        v = os.environ.get(prefix + suffix)
+        if v is not None:
+            return v
+    return None
 
 
 def get_logger() -> logging.Logger:
@@ -40,3 +61,17 @@ def configure(level: str, *, hide_timestamp: bool = False) -> None:
         "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
     for handler in logger.handlers:
         handler.setFormatter(logging.Formatter(fmt))
+
+
+def _configure_from_env() -> None:
+    """Apply the env knobs at import (the docstring's promise)."""
+    level = _env("LOG_LEVEL")
+    hide = _env("LOG_HIDE_TIME")
+    if level is None and hide is None:
+        return
+    configure(level or "warning",
+              hide_timestamp=(hide or "").strip().lower()
+              in ("1", "true", "yes", "on"))
+
+
+_configure_from_env()

@@ -13,6 +13,22 @@ EXECUTE (device time, asynchronous) → CALLBACK.  For on-device timing use
 ``jax.profiler`` traces, where XLA names each collective op; this timeline is
 the host-side engine view, same as the reference's.
 
+Timeline v2 (beyond the reference):
+
+- **Counter events** (``"ph": "C"``) — the engine samples the metrics
+  registry (:mod:`horovod_tpu.obs`) once per cycle into counter tracks,
+  so one Perfetto load shows queue depth / cumulative collective bytes as
+  graphs directly under the per-tensor spans.
+- **Flow events** (``"ph": "s"``/``"f"``) — an arrow from a tensor's
+  QUEUE span to its DISPATCH span, so a span picked in the execute phase
+  links back to the enqueue that caused it even when other tensors'
+  rows interleave.
+- **Crash durability** — the writer flushes periodically (and on
+  :meth:`flush`), registers an ``atexit`` close, and works as a context
+  manager; the Chrome trace format treats the closing ``]`` as optional,
+  so a trace cut off mid-run still loads with at most the
+  post-last-flush tail missing.
+
 The emitted file loads in ``chrome://tracing`` / Perfetto, like the
 reference's.  Events use one "pid" per engine and one "tid" per tensor name,
 matching the reference's layout (tensor rows).
@@ -20,6 +36,8 @@ matching the reference's layout (tensor rows).
 
 from __future__ import annotations
 
+import atexit
+import itertools
 import json
 import threading
 import time
@@ -29,20 +47,33 @@ from typing import Optional
 class Timeline:
     """Thread-safe Chrome-trace writer; no-op when ``path`` is None."""
 
-    def __init__(self, path: Optional[str], *, mark_cycles: bool = False) -> None:
+    def __init__(self, path: Optional[str], *, mark_cycles: bool = False,
+                 flush_interval_s: float = 1.0) -> None:
         self._path = path
         self._mark_cycles = mark_cycles
+        self._flush_interval = flush_interval_s
         self._lock = threading.Lock()
         self._fh = None
         self._tids: dict[str, int] = {}
         self._start = time.monotonic()
+        self._last_flush = self._start
+        self._flow_ids = itertools.count(1)
         if path:
             self._fh = open(path, "w")
             self._fh.write("[\n")
+            # Crash/exit durability: an unclosed timeline still flushes
+            # its tail at interpreter exit (close() unregisters this).
+            atexit.register(self.close)
 
     @property
     def enabled(self) -> bool:
         return self._fh is not None
+
+    def __enter__(self) -> "Timeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def _ts_us(self) -> float:
         return (time.monotonic() - self._start) * 1e6
@@ -61,6 +92,19 @@ class Timeline:
     def _emit(self, ev: dict) -> None:
         assert self._fh is not None
         self._fh.write(json.dumps(ev) + ",\n")
+        now = time.monotonic()
+        if now - self._last_flush >= self._flush_interval:
+            self._fh.flush()
+            self._last_flush = now
+
+    def flush(self) -> None:
+        """Push buffered events to disk so a crash right now loses
+        nothing written so far (Chrome/Perfetto accept the truncated
+        array — the closing ``]`` is optional in the trace format)."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                self._last_flush = time.monotonic()
 
     def start_activity(self, tensor_name: str, activity: str) -> None:
         """Begin a phase for a tensor († ``Timeline::ActivityStart``)."""
@@ -92,6 +136,49 @@ class Timeline:
             self._emit({"name": "CYCLE", "ph": "i", "s": "g", "pid": 0,
                         "tid": 0, "ts": self._ts_us()})
 
+    # -- Timeline v2 ---------------------------------------------------------
+    def new_flow(self) -> int:
+        """Fresh flow id for a QUEUE→DISPATCH arrow."""
+        return next(self._flow_ids)
+
+    def flow_start(self, tensor_name: str, flow_id: int) -> None:
+        """Open a flow arrow at the tensor's current span (emit right
+        after the QUEUE ``start_activity``)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._fh is None:
+                return
+            self._emit({"name": "hvd.link", "cat": "flow", "ph": "s",
+                        "id": flow_id, "pid": 0,
+                        "tid": self._tid(tensor_name), "ts": self._ts_us()})
+
+    def flow_end(self, tensor_name: str, flow_id: int) -> None:
+        """Land the arrow on the tensor's current span (emit right after
+        the DISPATCH ``start_activity``); ``bp: "e"`` binds it to the
+        enclosing slice."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._fh is None:
+                return
+            self._emit({"name": "hvd.link", "cat": "flow", "ph": "f",
+                        "bp": "e", "id": flow_id, "pid": 0,
+                        "tid": self._tid(tensor_name), "ts": self._ts_us()})
+
+    def counter(self, name: str, values: dict) -> None:
+        """Counter track sample (``"ph": "C"``): ``values`` is a flat
+        ``{series: number}`` dict, rendered by Perfetto as stacked
+        graphs.  The engine feeds these from the metrics registry once
+        per cycle."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._fh is None:
+                return
+            self._emit({"name": name, "ph": "C", "pid": 0, "tid": 0,
+                        "ts": self._ts_us(), "args": dict(values)})
+
     def close(self) -> None:
         with self._lock:
             if self._fh is None:
@@ -102,3 +189,4 @@ class Timeline:
                 {"name": "trace_end", "ph": "M", "pid": 0, "tid": 0}) + "\n]\n")
             self._fh.close()
             self._fh = None
+        atexit.unregister(self.close)

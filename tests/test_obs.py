@@ -1,0 +1,279 @@
+"""Observability plane: metrics registry, exposition, HTTP endpoint,
+Timeline v2 (counter + flow events), and the cross-layer wiring.
+
+The registry/export tests run on private ``MetricRegistry`` instances so
+they are deterministic regardless of what the session's engine has
+already recorded into the process-wide default registry; the wiring
+tests drive the real engine/serving paths and only assert deltas.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.obs import (
+    REGISTRY,
+    MetricError,
+    MetricRegistry,
+    export,
+    server,
+)
+from horovod_tpu.utils.timeline import Timeline
+
+N = 8
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_counter_concurrent_increments():
+    reg = MetricRegistry()
+    c = reg.counter("t_events_total")
+    per_thread = 5000
+
+    def work():
+        for _ in range(per_thread):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8 * per_thread
+
+
+def test_counter_rejects_negative_and_gauge_moves_both_ways():
+    reg = MetricRegistry()
+    with pytest.raises(MetricError):
+        reg.counter("c_total").inc(-1)
+    g = reg.gauge("g")
+    g.set(5)
+    g.inc(2)
+    g.dec(3)
+    assert g.value == 4
+
+
+def test_histogram_bucket_edges_are_inclusive_upper_bounds():
+    reg = MetricRegistry()
+    h = reg.histogram("h_seconds", buckets=(1.0, 2.0, 4.0))
+    for v in (1.0, 2.0, 2.0001, 5.0):   # edge, edge, just-over, overflow
+        h.observe(v)
+    [sample] = reg.snapshot()[0]["samples"]
+    assert sample["buckets"] == [(1.0, 1), (2.0, 2), (4.0, 3),
+                                 (float("inf"), 4)]
+    assert sample["count"] == 4
+    assert sample["sum"] == pytest.approx(10.0001)
+
+
+def test_labels_kind_conflicts_and_reset():
+    reg = MetricRegistry()
+    c = reg.counter("req_total", labelnames=("verb",))
+    c.labels(verb="a").inc(2)
+    c.labels(verb="b").inc(3)
+    assert c.total() == 5
+    with pytest.raises(MetricError):
+        c.inc()                      # labeled family needs .labels()
+    with pytest.raises(MetricError):
+        c.labels(wrong="x")
+    with pytest.raises(MetricError):
+        reg.gauge("req_total")       # kind conflict
+    assert reg.counter("req_total", labelnames=("verb",)) is c  # idempotent
+    reg.reset()
+    assert c.total() == 0
+    assert c.labels(verb="a").value == 0  # children survive reset
+
+
+def test_disable_makes_recording_a_noop():
+    reg = MetricRegistry()
+    c = reg.counter("c_total")
+    h = reg.histogram("h_seconds")
+    reg.disable()
+    c.inc()
+    h.observe(1.0)
+    reg.enable()
+    c.inc()
+    assert c.value == 1 and h.count == 0
+
+
+# ---------------------------------------------------------------------------
+# exposition
+# ---------------------------------------------------------------------------
+
+def _golden_registry() -> MetricRegistry:
+    reg = MetricRegistry()
+    c = reg.counter("req_total", "requests by code", ("code",))
+    c.labels(code="200").inc(3)
+    c.labels(code="500").inc()
+    reg.gauge("depth", "queue depth").set(2.5)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    return reg
+
+
+GOLDEN = """\
+# HELP depth queue depth
+# TYPE depth gauge
+depth 2.5
+# HELP lat_seconds latency
+# TYPE lat_seconds histogram
+lat_seconds_bucket{le="0.1"} 1
+lat_seconds_bucket{le="1"} 2
+lat_seconds_bucket{le="+Inf"} 2
+lat_seconds_sum 0.55
+lat_seconds_count 2
+# HELP req_total requests by code
+# TYPE req_total counter
+req_total{code="200"} 3
+req_total{code="500"} 1
+"""
+
+
+def test_prometheus_golden_text():
+    text = export.to_prometheus(_golden_registry().snapshot())
+    assert text == GOLDEN
+    export.validate_prometheus(text)
+
+
+def test_json_exposition_parses_and_matches():
+    blob = json.loads(export.to_json(_golden_registry().snapshot()))
+    fams = {m["name"]: m for m in blob["metrics"]}
+    assert fams["req_total"]["samples"][0]["value"] == 3
+    hist = fams["lat_seconds"]["samples"][0]
+    assert hist["count"] == 2 and hist["buckets"][-1] == ["+Inf", 2]
+
+
+def test_validate_catches_malformed_exposition():
+    with pytest.raises(ValueError):
+        export.validate_prometheus("no_type_header 1\n")
+    with pytest.raises(ValueError):
+        export.validate_prometheus("# TYPE x counter\nx 1 2 3\n")
+
+
+def test_http_endpoint_roundtrip():
+    reg = _golden_registry()
+    srv = server.MetricsServer(0, addr="127.0.0.1", registry=reg)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        resp = urllib.request.urlopen(f"{base}/metrics", timeout=10)
+        assert resp.headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4")
+        text = resp.read().decode()
+        assert text == GOLDEN
+        export.validate_prometheus(text)
+        blob = json.loads(urllib.request.urlopen(
+            f"{base}/metrics.json", timeout=10).read().decode())
+        assert {m["name"] for m in blob["metrics"]} == \
+            {"req_total", "depth", "lat_seconds"}
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=10)
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Timeline v2
+# ---------------------------------------------------------------------------
+
+def test_timeline_v2_counter_and_flow_events(tmp_path):
+    path = tmp_path / "tl.json"
+    with Timeline(str(path)) as tl:
+        tl.start_activity("tensor", "QUEUE")
+        fid = tl.new_flow()
+        tl.flow_start("tensor", fid)
+        tl.end_activity("tensor")
+        tl.start_activity("tensor", "DISPATCH")
+        tl.flow_end("tensor", fid)
+        tl.counter("hvd.engine", {"queue_depth": 3, "bytes": 16.0})
+        tl.end_activity("tensor")
+    events = json.loads(path.read_text())     # Perfetto-parseable JSON
+    by_ph = {}
+    for ev in events:
+        by_ph.setdefault(ev["ph"], []).append(ev)
+    assert by_ph["s"][0]["id"] == fid and by_ph["s"][0]["cat"] == "flow"
+    assert by_ph["f"][0]["id"] == fid and by_ph["f"][0]["bp"] == "e"
+    assert by_ph["C"][0]["args"] == {"queue_depth": 3, "bytes": 16.0}
+    assert len(by_ph["B"]) == 2 and len(by_ph["E"]) == 2
+
+
+def test_timeline_flush_survives_without_close(tmp_path):
+    path = tmp_path / "tl.json"
+    tl = Timeline(str(path))
+    tl.start_activity("t", "QUEUE")
+    tl.flush()
+    raw = path.read_text()
+    assert '"QUEUE"' in raw                   # on disk before close
+    # Chrome/Perfetto accept the truncated array (no closing bracket);
+    # emulate that tolerance to prove the tail parses.
+    events = json.loads(raw.rstrip().rstrip(",") + "]")
+    assert any(ev.get("name") == "QUEUE" for ev in events)
+    tl.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-layer wiring
+# ---------------------------------------------------------------------------
+
+def test_engine_series_and_hvd_metrics_api(tmp_path):
+    col = REGISTRY.get("hvd_collectives_total")
+    byt = REGISTRY.get("hvd_collective_bytes_total")
+    before_n, before_b = col.total(), byt.total()
+    tl_path = tmp_path / "tl.json"
+    hvd.start_timeline(str(tl_path))
+    try:
+        x = hvd.per_rank(
+            [np.full((16,), float(r), np.float32) for r in range(N)])
+        h = hvd.allreduce_async(x, hvd.Average, name="obs.t1")
+        hvd.synchronize(h)
+    finally:
+        hvd.stop_timeline()
+    assert col.total() == before_n + 1
+    assert byt.total() == before_b + N * 16 * 4
+    events = json.loads(tl_path.read_text())
+    phs = {ev["ph"] for ev in events}
+    assert {"s", "f", "C"} <= phs             # flows + counter tracks
+    counter_ev = next(ev for ev in events if ev["ph"] == "C")
+    assert counter_ev["args"]["collectives_total"] >= 1
+    # hvd.metrics(): all three formats over the same snapshot
+    text = hvd.metrics("prometheus")
+    export.validate_prometheus(text)
+    assert "hvd_collectives_total" in text
+    assert "hvd_dispatch_cache_hits_total" in text
+    names = {m["name"] for m in hvd.metrics()}
+    assert "hvd_collective_bytes_total" in names
+    json.loads(hvd.metrics("json"))
+    with pytest.raises(ValueError):
+        hvd.metrics("xml")
+
+
+def test_serving_request_metrics_reach_registry():
+    import jax
+
+    from horovod_tpu import serving
+    from horovod_tpu.models import llama
+
+    ttft = REGISTRY.get("hvd_serving_ttft_seconds")
+    reqs = REGISTRY.get("hvd_serving_requests_total")
+    before_count = ttft.count
+    before_done = reqs.labels(outcome="finished").value
+
+    cfg = llama.LlamaConfig.tiny()            # v256 d64 L2 H4 KV2 fp32
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    sess = serving.serve(params, cfg, num_blocks=16, block_size=8,
+                         max_active=2)
+    fut = sess.submit(np.arange(5, dtype=np.int32), max_tokens=4)
+    sess.drain()
+    res = fut.result(timeout=30)
+    assert len(res.tokens) == 4
+    assert ttft.count == before_count + 1
+    assert reqs.labels(outcome="finished").value == before_done + 1
+    assert REGISTRY.get("hvd_serving_kv_utilization") is not None
+    text = hvd.metrics("prometheus")
+    assert "hvd_serving_ttft_seconds_bucket" in text
+    assert "hvd_serving_kv_utilization" in text
