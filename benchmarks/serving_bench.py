@@ -206,7 +206,25 @@ def main() -> None:
 
     # Instrumentation overhead: back-to-back closed-load passes with the
     # registry recording vs disabled (budget <2% — the obs acceptance bar).
-    tok_on, wall_on, _ = run_engine(sess, reqs, 0.0)
+    # The "on" pass runs with cluster aggregation active — a background
+    # scraper taking full snapshot+merge passes at the publish cadence —
+    # so the budget covers the distributed plane, not just bare counters
+    # (snapshot holds the registry lock the hot path's recorders want).
+    import threading as _threading
+    agg_stop = _threading.Event()
+
+    def _aggregate_loop():
+        while not agg_stop.is_set():
+            hvd.cluster_metrics()
+            agg_stop.wait(obs.aggregate.publish_interval_from_env())
+
+    agg_thread = _threading.Thread(target=_aggregate_loop, daemon=True)
+    agg_thread.start()
+    try:
+        tok_on, wall_on, _ = run_engine(sess, reqs, 0.0)
+    finally:
+        agg_stop.set()
+        agg_thread.join(timeout=5)
     obs.REGISTRY.disable()
     try:
         tok_off, wall_off, _ = run_engine(sess, reqs, 0.0)
@@ -214,8 +232,8 @@ def main() -> None:
         obs.REGISTRY.enable()
     rate_on, rate_off = tok_on / wall_on, tok_off / wall_off
     overhead_pct = (rate_off - rate_on) / rate_off * 100.0
-    print(f"[obs overhead] metrics on {rate_on:.1f} tok/s vs off "
-          f"{rate_off:.1f} tok/s = {overhead_pct:+.2f}% "
+    print(f"[obs overhead] metrics+aggregation on {rate_on:.1f} tok/s vs "
+          f"off {rate_off:.1f} tok/s = {overhead_pct:+.2f}% "
           f"({'within' if overhead_pct < 2.0 else 'OVER'} the 2% budget)")
 
     base_rate = base_tok / base_s

@@ -580,6 +580,29 @@ def metrics(fmt: str = "dict"):
     part of engine state.
     """
     snap = obs.REGISTRY.snapshot()
+    return _format_snapshot(snap, fmt)
+
+
+def cluster_metrics(fmt: str = "dict"):
+    """Job-level merged view of every rank's metrics registry.
+
+    Each rank periodically publishes its registry snapshot to the job's
+    KV control plane (armed by ``hvd.init()`` in multi-process mode);
+    this fetches and merges them: counters keep per-rank ``rank``-labeled
+    series plus a cluster-summed series, gauges stay per-rank, histogram
+    buckets merge when the edges agree.  Formats as :func:`metrics`.
+    The same view is served over HTTP at ``/cluster`` (Prometheus) and
+    ``/cluster.json`` next to the per-process ``/metrics``.
+
+    Works on any rank with KV access (rank 0 is the canonical scrape
+    target); single-process jobs return the local registry labeled
+    ``rank="0"`` — the world-size-1 cluster, no special case needed.
+    """
+    from .obs import aggregate
+    return _format_snapshot(aggregate.cluster_snapshot(), fmt)
+
+
+def _format_snapshot(snap, fmt: str):
     if fmt == "dict":
         return snap
     if fmt == "json":
@@ -597,12 +620,16 @@ def metrics(fmt: str = "dict"):
 def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
     """Begin writing the Chrome-trace timeline at runtime
     († ``hvd.start_timeline``).  Replaces any active timeline."""
+    import jax
     from .utils.timeline import Timeline
     state = global_state()
     if not state.initialized:
         raise NotInitializedError()
     old = state.timeline
-    state.timeline = Timeline(file_path, mark_cycles=mark_cycles)
+    # rank stamps the clock_sync merge anchor, same as init()'s timeline,
+    # so runtime-started per-rank files merge onto correct lanes too.
+    state.timeline = Timeline(file_path, mark_cycles=mark_cycles,
+                              rank=jax.process_index())
     if old is not None:
         old.close()
 

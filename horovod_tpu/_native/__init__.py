@@ -11,7 +11,17 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import NamedTuple, Optional
+from types import MappingProxyType
+from typing import Mapping, NamedTuple, Optional
+
+
+class StallInfo(NamedTuple):
+    """Attribution for one stalled tensor: the ranks that have NOT
+    submitted it (the stragglers) and how long it has been waiting.
+    The controller computes both from the readiness bitmap it already
+    walks († stall_inspector.cc reported only the name)."""
+    missing_ranks: tuple
+    age_ms: int
 
 
 class NegotiationResult(NamedTuple):
@@ -25,6 +35,8 @@ class NegotiationResult(NamedTuple):
     fabricated zero participation — only allreduce may dispatch for these
     († the reference errors non-allreduce ops while any rank is joined).
     ``all_joined`` / ``last_join_rank``: † ``hvd.join()`` completion signal.
+    ``stall_info``: name → :class:`StallInfo` for every stalled tensor
+    (straggler attribution: which ranks are withholding, for how long).
     """
     ready: list
     stalled: list
@@ -32,6 +44,9 @@ class NegotiationResult(NamedTuple):
     all_joined: bool
     last_join_rank: int
     join_covered: frozenset = frozenset()
+    # Immutable default: a plain {} here would be one shared class-level
+    # dict across every default-constructed result.
+    stall_info: Mapping = MappingProxyType({})
 
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 _REPO_ROOT = os.path.dirname(os.path.dirname(_PKG_DIR))
@@ -329,10 +344,29 @@ class ControllerClient:
                 metas[name] = meta
             if len(parts) > 2 and parts[2] == "j":
                 covered.add(name)
-        stalled = [s for s in stalled_part.split("\n") if s]
+        stalled, stall_info = [], {}
+        for item in stalled_part.split("\n"):
+            if not item:
+                continue
+            parts = item.split("\x02")
+            name = parts[0]
+            stalled.append(name)
+            missing: tuple = ()
+            age_ms = 0
+            if len(parts) > 1 and parts[1]:
+                try:
+                    missing = tuple(int(r) for r in parts[1].split(","))
+                except ValueError:
+                    missing = ()
+            if len(parts) > 2:
+                try:
+                    age_ms = int(parts[2])
+                except ValueError:
+                    age_ms = 0
+            stall_info[name] = StallInfo(missing, age_ms)
         return NegotiationResult(ready, stalled, metas,
                                  bool(all_joined.value), last_rank.value,
-                                 frozenset(covered))
+                                 frozenset(covered), stall_info)
 
     @property
     def cache_size(self) -> int:

@@ -187,7 +187,11 @@ def init(
         _state.mesh = Mesh(np.array(devs), axis_names=(cfg.dp_axis_name,))
 
         from .utils.timeline import Timeline
-        _state.timeline = Timeline(cfg.timeline, mark_cycles=cfg.timeline_mark_cycles)
+        # rank stamps the clock_sync merge anchor so `timeline merge`
+        # can rebase per-rank files onto one axis without filename hints.
+        _state.timeline = Timeline(cfg.timeline,
+                                   mark_cycles=cfg.timeline_mark_cycles,
+                                   rank=jax.process_index())
 
         from .ops.engine import CollectiveEngine
         negotiator = None
@@ -225,10 +229,42 @@ def init(
                 log.warning("metrics endpoint not started on port %d: %s",
                             cfg.metrics_port, e)
 
+        # Obs plane: self-identifying info gauge + cluster aggregation.
+        # Every scrape (and every aggregated snapshot) then answers
+        # who/where/what-version without joining against launch logs.
+        try:
+            _arm_obs_plane()
+        except Exception as e:  # telemetry must never fail init
+            log.warning("obs plane not armed: %s", e)
+
         _state.initialized = True
         log.info(
             "horovod_tpu initialized: size=%d local_size=%d rank=%d backend=%s",
             _state.size, _state.local_size, _state.rank, jax.default_backend())
+
+
+def _arm_obs_plane() -> None:
+    """Register ``horovod_tpu_build_info`` and start cross-rank snapshot
+    publishing/aggregation (:mod:`horovod_tpu.obs.aggregate`).  Called
+    under the init lock; re-entrant across elastic re-inits (a changed
+    world size re-labels the info gauge and restarts the publisher)."""
+    from . import __version__ as version
+    from .obs import REGISTRY as obs_registry
+    from .obs import aggregate as obs_aggregate
+
+    dev = _state.devices[0]
+    g = obs_registry.gauge(
+        "horovod_tpu_build_info",
+        "always 1; labels self-identify the scraped process "
+        "(version/rank/world size/device kind)",
+        ("version", "rank", "size", "device_kind"))
+    # Elastic re-init can change rank/size: zero children from the old
+    # world so only the current identity reads 1.
+    g.zero_all()
+    g.labels(version=version, rank=str(jax.process_index()),
+             size=str(jax.process_count()),
+             device_kind=getattr(dev, "device_kind", dev.platform)).set(1)
+    obs_aggregate.start_for_rank(jax.process_index(), jax.process_count())
 
 
 def shutdown() -> None:
@@ -236,6 +272,8 @@ def shutdown() -> None:
     with _state.lock:
         if not _state.initialized:
             return
+        from .obs import aggregate as obs_aggregate
+        obs_aggregate.stop()
         if _state.engine is not None:
             _state.engine.stop()
             _state.engine = None

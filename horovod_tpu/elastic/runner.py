@@ -75,10 +75,17 @@ class WorkerNotificationClient:
 
 def _reinitialize() -> None:
     """Tear down and re-init the runtime on the (possibly changed) device
-    set — the TPU analogue of re-forming the Gloo ring (†3.5 reinit)."""
+    set — the TPU analogue of re-forming the Gloo ring (†3.5 reinit).
+
+    init() re-arms the obs plane with the new rank/size (build-info
+    gauge re-labeled, snapshot publisher restarted); the immediate
+    publish below makes the cluster ``/cluster`` view reflect the new
+    world without waiting out a publish interval."""
     import horovod_tpu as hvd
     hvd.shutdown()
     hvd.init()
+    from ..obs import aggregate
+    aggregate.publish_now()
 
 
 def run(func: Callable[..., Any]) -> Callable[..., Any]:

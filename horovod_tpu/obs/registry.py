@@ -240,6 +240,15 @@ class Gauge(MetricFamily):
     def _make_child(self):
         return _GaugeChild(self._registry)
 
+    def zero_all(self) -> None:
+        """Set every label child to 0 (children stay registered).  For
+        identity-style gauges whose label values change over the process
+        lifetime (e.g. build-info relabeled on elastic re-init): zero the
+        stale identities so only the current one reads 1."""
+        with self._registry._lock:
+            for child in self._children.values():
+                child._reset()
+
     def set(self, value: float) -> None:
         self._default().set(value)
 

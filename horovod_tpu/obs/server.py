@@ -32,6 +32,20 @@ _ENV_VARS = ("HVDTPU_METRICS_PORT", "HOROVOD_TPU_METRICS_PORT",
              "HOROVOD_METRICS_PORT")
 
 
+_cluster_provider = None
+_cluster_lock = threading.Lock()
+
+
+def set_cluster_provider(fn) -> None:
+    """Register (or clear, with ``None``) the callable that produces the
+    merged cluster snapshot served at ``/cluster``.  Module-global so the
+    env-autostarted server (up since import) gains the route the moment
+    ``hvd.init()`` arms aggregation."""
+    global _cluster_provider
+    with _cluster_lock:
+        _cluster_provider = fn
+
+
 def _make_handler(registry: MetricRegistry):
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - http.server API
@@ -42,8 +56,26 @@ def _make_handler(registry: MetricRegistry):
             elif path == "/metrics.json":
                 body = export.to_json(registry.snapshot())
                 ctype = "application/json"
+            elif path in ("/cluster", "/cluster.json"):
+                with _cluster_lock:
+                    provider = _cluster_provider
+                if provider is None:
+                    self.send_error(
+                        503, "cluster aggregation not armed on this "
+                             "process (hvd.init() arms it; per-process "
+                             "series stay on /metrics)")
+                    return
+                snap = provider()
+                if path == "/cluster":
+                    body = export.to_prometheus(snap)
+                    ctype = PROMETHEUS_CONTENT_TYPE
+                else:
+                    body = export.to_json(snap)
+                    ctype = "application/json"
             else:
-                self.send_error(404, "try /metrics or /metrics.json")
+                self.send_error(
+                    404, "try /metrics, /metrics.json, /cluster or "
+                         "/cluster.json")
                 return
             payload = body.encode("utf-8")
             self.send_response(200)

@@ -1,24 +1,35 @@
 """CI smoke for the observability plane: ``python -m horovod_tpu.obs.smoke``.
 
-One self-contained pass over the whole pipeline: register metrics of all
-three kinds, generate traffic, start the HTTP endpoint (env port or
-ephemeral), scrape both formats, and validate the Prometheus text with
-the same :func:`horovod_tpu.obs.export.validate_prometheus` the unit
-tests use.  Exit code 0 = the telemetry plane works end to end.
+Two self-contained passes:
+
+1. **Process pass** — register metrics of all three kinds, generate
+   traffic, start the HTTP endpoint (env port or ephemeral), scrape both
+   formats, and validate the Prometheus text with the same
+   :func:`horovod_tpu.obs.export.validate_prometheus` the unit tests use.
+2. **Cluster pass** — start the native KV store, spawn two real worker
+   processes that each publish a rank-tagged registry snapshot
+   (``--worker <rank>`` re-entry), aggregate them, serve the merged view
+   at ``/cluster``, scrape it, and validate: per-rank ``rank``-labeled
+   series from both ranks, cluster-summed counters, valid exposition.
+
+Exit code 0 = the telemetry plane works end to end, single- and
+multi-process.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import secrets
+import subprocess
 import sys
 import urllib.request
 
 from . import export, server
-from .registry import MetricRegistry
+from .registry import REGISTRY, MetricRegistry
 
 
-def main() -> int:
+def _process_pass() -> int:
     reg = MetricRegistry()
     c = reg.counter("smoke_events_total", "smoke traffic", ("kind",))
     c.labels(kind="scrape").inc()
@@ -58,6 +69,95 @@ def main() -> int:
     print(f"obs smoke OK: scraped :{srv.port}/metrics "
           f"({len(text.splitlines())} lines, exposition valid)")
     return 0
+
+
+def _worker(rank: int) -> int:
+    """Re-entry for the cluster pass: record rank-distinct traffic into
+    the process-default registry and publish one snapshot to the KV
+    store the parent armed via the environment."""
+    from . import aggregate
+
+    REGISTRY.counter(
+        "smoke_cluster_events_total", "cluster smoke traffic"
+    ).inc(rank + 1)
+    REGISTRY.gauge("smoke_cluster_depth", "per-rank gauge").set(rank * 10)
+    h = REGISTRY.histogram("smoke_cluster_latency_seconds",
+                           "per-rank latency", buckets=(0.01, 0.1, 1.0))
+    h.observe(0.05 * (rank + 1))
+    pub = aggregate.RankPublisher(rank, 2, interval_s=3600)
+    ok = pub.publish_now()
+    pub.stop(retract=False)   # the parent aggregates after we exit
+    return 0 if ok else 1
+
+
+def _cluster_pass() -> int:
+    from . import aggregate
+    try:
+        from .._native import KvServer
+        kv_srv = KvServer(secret=os.environ.setdefault(
+            "HVDTPU_SECRET", secrets.token_hex(8)))
+    except OSError as e:
+        # The native-build CI job owns build failures; the obs smoke
+        # reports (not fails) when the control plane is absent.
+        print(f"obs smoke: cluster pass SKIPPED (native core "
+              f"unavailable: {e})", file=sys.stderr)
+        return 0
+    srv = None
+    try:
+        os.environ["HVDTPU_RENDEZVOUS_ADDR"] = f"127.0.0.1:{kv_srv.port}"
+        for rank in range(2):
+            res = subprocess.run(
+                [sys.executable, "-m", "horovod_tpu.obs.smoke",
+                 "--worker", str(rank)],
+                env=dict(os.environ), timeout=60)
+            if res.returncode != 0:
+                print(f"obs smoke FAILED: worker {rank} exited "
+                      f"{res.returncode}", file=sys.stderr)
+                return 1
+        agg = aggregate.ClusterAggregator(own_size=2, include_local=False)
+        server.set_cluster_provider(agg.collect)
+        srv = server.MetricsServer(0, addr="127.0.0.1")
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/cluster", timeout=10
+        ).read().decode()
+        export.validate_prometheus(text)
+        for needle in ('smoke_cluster_events_total{rank="0"} 1',
+                       'smoke_cluster_events_total{rank="1"} 2',
+                       "smoke_cluster_events_total 3",   # cluster sum
+                       'smoke_cluster_depth{rank="1"} 10',
+                       "smoke_cluster_latency_seconds_count 2",
+                       "horovod_tpu_cluster_ranks_reporting 2"):
+            if needle not in text:
+                print(f"obs smoke FAILED: {needle!r} missing from "
+                      f"/cluster exposition:\n{text}", file=sys.stderr)
+                return 1
+        blob = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/cluster.json", timeout=10
+        ).read().decode())
+        names = {m["name"] for m in blob["metrics"]}
+        if "smoke_cluster_events_total" not in names:
+            print(f"obs smoke FAILED: /cluster.json missing families "
+                  f"({names})", file=sys.stderr)
+            return 1
+        agg.close()
+    finally:
+        server.set_cluster_provider(None)
+        if srv is not None:
+            srv.close()
+        kv_srv.stop()
+    print("obs smoke OK: /cluster aggregated 2 worker processes "
+          "(rank-labeled + summed series, exposition valid)")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["--worker"]:
+        return _worker(int(argv[1]))
+    rc = _process_pass()
+    if rc != 0:
+        return rc
+    return _cluster_pass()
 
 
 if __name__ == "__main__":

@@ -208,6 +208,9 @@ class NegotiationOutcome:
     returns an error Response for non-allreduce ops while a rank is
     joined).
     ``all_joined`` / ``last_join_rank``: † ``hvd.join()`` completion.
+    ``stall_info``: name → attribution record (which ranks never
+    submitted a stalled tensor, and its age) from the coordinator's
+    stall inspector; empty in single-controller mode.
     """
     ready: list[str]
     stalled: list[str] = field(default_factory=list)
@@ -215,6 +218,7 @@ class NegotiationOutcome:
     all_joined: bool = False
     last_join_rank: int = 0
     join_covered: set = field(default_factory=set)
+    stall_info: dict = field(default_factory=dict)
 
 
 class Negotiator:
@@ -229,6 +233,12 @@ class Negotiator:
                   joined: bool = False) -> NegotiationOutcome:
         """Return the agreed ready set (ordered) for this cycle."""
         raise NotImplementedError
+
+    def stall_attribution(self, name: str) -> Optional[str]:
+        """Straggler attribution for a stalled tensor ("awaiting rank(s)
+        3, 12s"), when this protocol can know it; None otherwise.  The
+        engine folds it into stall warnings and shutdown errors."""
+        return None
 
     def close(self) -> None:
         pass
@@ -423,7 +433,19 @@ class CollectiveEngine:
                 with self._lock:
                     self._names_pending.discard(e.name)
                 self._tl_close(e)
-                h._complete(error=err)
+                # A round abort usually means a peer stall-shut-down
+                # first; fold the last known straggler attribution into
+                # THIS entry's error so victim ranks also learn which
+                # rank was withholding what, not just that a peer died.
+                e_err = err
+                attr = self._negotiator.stall_attribution(e.name)
+                if attr is not None:
+                    try:
+                        e_err = type(err)(
+                            f"{err} [stalled tensor {e.name!r}: {attr}]")
+                    except Exception:   # exotic ctor: keep the original
+                        e_err = err
+                h._complete(error=e_err)
             if join_req:
                 with self._lock:
                     self._join_requested = False
@@ -734,7 +756,15 @@ class CollectiveEngine:
                        if now - e.enqueue_time > cfg.stall_warning_time_s]
         if stalled:
             self._last_stall_warn = now
-            desc = ", ".join(f"{n} ({age:.0f}s)" for n, age in stalled)
+            # Fold in the coordinator's straggler attribution when the
+            # protocol knows it (multi-process mode): the shutdown error
+            # then names the exact withholding rank(s), not just the
+            # tensor († the reference's stall log stopped at the name).
+            def _desc(n: str, age: float) -> str:
+                attr = self._negotiator.stall_attribution(n)
+                return (f"{n} ({age:.0f}s; {attr})" if attr
+                        else f"{n} ({age:.0f}s)")
+            desc = ", ".join(_desc(n, age) for n, age in stalled)
             log.warning(
                 "Stall detected: collectives pending > %.0fs without "
                 "completing negotiation: %s. One or more ranks may have "

@@ -490,13 +490,19 @@ def default_blocks(seq_len: int) -> tuple[int, int]:
     accumulation — the fp32-cast version ran the matmuls at fp32 MXU
     rate and its optimum differed):
 
-        S=512:  (512, 256) → 1.89 ms, 1.01x
+        S=512:  (256, 256) → 1.01x (parity; decision in BASELINE.md —
+                all S=512 blockings sit within noise of dense, and the
+                committed sweep's fastest point is 256×256)
         S=1024: (512, 512) → 2.42 ms, 1.82x
         S=2048: (512, 512) → 4.79 ms, 2.54x
         S=4096: (512, 512) → 12.4 ms, 5.28x
     """
     if seq_len == 512:
-        return 512, 256
+        # Kept on the flash path at parity (≥1x) rather than gated to
+        # dense: one uniform code path across lengths, and the smaller
+        # resident set leaves VMEM headroom.  See "S=512 flash decision"
+        # in BASELINE.md (round-6 close of VERDICT ask #5).
+        return 256, 256
     if seq_len % 512 == 0:
         return 512, 512
     b = next((c for c in (256, 128) if seq_len % c == 0), 128)

@@ -56,18 +56,33 @@ def kv_put_blob(kv, prefix: str, data: bytes) -> None:
     """Store ``data`` under ``prefix`` in ≤4 MiB chunks.
 
     The meta key goes LAST so a blocking reader that sees it can read
-    every chunk without racing the writer."""
+    every chunk without racing the writer; it carries the total length
+    so a reader racing a REWRITE of the same prefix (the obs plane
+    republishes ``obs/rank/<r>`` every interval; run_func keys are
+    write-once and never hit this) detects the torn read instead of
+    returning spliced bytes."""
     n = max(1, (len(data) + _CHUNK - 1) // _CHUNK)
     for i in range(n):
         kv.set(f"{prefix}/{i}", data[i * _CHUNK:(i + 1) * _CHUNK])
-    kv.set(f"{prefix}/meta", str(n).encode())
+    kv.set(f"{prefix}/meta", f"{n}:{len(data)}".encode())
 
 
 def kv_get_blob(kv, prefix: str, timeout_ms: int = 10000) -> bytes:
-    """Blocking fetch of a chunked blob stored by :func:`kv_put_blob`."""
-    n = int(kv.wait(f"{prefix}/meta", timeout_ms=timeout_ms))
-    return b"".join(kv.wait(f"{prefix}/{i}", timeout_ms=timeout_ms)
+    """Blocking fetch of a chunked blob stored by :func:`kv_put_blob`.
+
+    Raises ``ValueError`` when the assembled length contradicts the
+    meta record (concurrent rewrite of the prefix) — callers on
+    rewritable keys retry or skip; write-once keys never see it."""
+    meta = kv.wait(f"{prefix}/meta", timeout_ms=timeout_ms).decode()
+    n_str, _, len_str = meta.partition(":")
+    n = int(n_str)
+    blob = b"".join(kv.wait(f"{prefix}/{i}", timeout_ms=timeout_ms)
                     for i in range(n))
+    if len_str and len(blob) != int(len_str):
+        raise ValueError(
+            f"blob {prefix!r} torn mid-rewrite "
+            f"(meta says {len_str} bytes, read {len(blob)})")
+    return blob
 
 
 def _collect(kv, np_total: int, results: dict, stop: threading.Event) -> None:

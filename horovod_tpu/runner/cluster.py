@@ -50,7 +50,8 @@ class DriverServices:
 
     def __init__(self, num_proc: int, *, service_ip: Optional[str] = None,
                  secret: Optional[str] = None,
-                 stall_shutdown_s: Optional[float] = None) -> None:
+                 stall_shutdown_s: Optional[float] = None,
+                 stall_warn_s: Optional[float] = None) -> None:
         from .._native import ControllerServer, KvServer
 
         if num_proc < 1:
@@ -67,16 +68,25 @@ class DriverServices:
         # Callers whose stall knob does not live in this process's env
         # (hvdrun --config-file puts it only in the WORKER env) must pass
         # ``stall_shutdown_s`` explicitly.
-        if stall_shutdown_s is None:
+        if stall_shutdown_s is None or stall_warn_s is None:
             from .. import config as config_mod
-            stall_shutdown_s = config_mod.from_env().stall_shutdown_time_s
+            cfg = config_mod.from_env()
+            if stall_shutdown_s is None:
+                stall_shutdown_s = cfg.stall_shutdown_time_s
+            if stall_warn_s is None:
+                # The controller's stall inspector (straggler attribution:
+                # which ranks never submitted a pending tensor) must fire
+                # on the same timescale as the workers' own stall checks,
+                # not the native default.
+                stall_warn_s = cfg.stall_warning_time_s
         round_abort_ms = 0
         if stall_shutdown_s and stall_shutdown_s > 0:
             round_abort_ms = int(stall_shutdown_s * 2 * 1000)
         try:
-            self.controller = ControllerServer(size=num_proc,
-                                               secret=self.secret,
-                                               round_abort_ms=round_abort_ms)
+            self.controller = ControllerServer(
+                size=num_proc, secret=self.secret,
+                stall_warn_ms=max(1, int(stall_warn_s * 1000)),
+                round_abort_ms=round_abort_ms)
         except Exception:
             self.kv.stop()  # construction failed; __exit__ will never run
             raise

@@ -36,6 +36,10 @@ _m_rendezvous_rounds = _obs.counter(
 _m_hosts = _obs.gauge(
     "hvd_elastic_available_hosts",
     "non-blacklisted hosts in the current assignment")
+_m_epoch = _obs.gauge(
+    "hvd_elastic_membership_epoch",
+    "membership epoch of the assignment the driver last launched "
+    "(aggregated per-rank, a lagging rank shows a stale epoch)")
 
 
 class HostDiscovery:
@@ -263,6 +267,7 @@ class ElasticDriver:
             epoch = self.membership_epoch
             _m_rendezvous_rounds.inc()
             _m_hosts.set(len(hosts))
+            _m_epoch.set(epoch)
             log.info("elastic: launching on %s (epoch %d)", hosts, epoch)
             env = dict(extra_env or {})
             env["HVDTPU_ELASTIC"] = "1"

@@ -93,6 +93,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--autotune", action="store_true", default=False)
     p.add_argument("--autotune-log", default=None)
     p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-dir", default=None,
+                   help="write one Timeline v2 file per rank "
+                        "(<dir>/rank<r>.json) and merge the local ones "
+                        "into <dir>/merged.json after the run — one "
+                        "Perfetto trace, one pid lane per rank "
+                        "(python -m horovod_tpu.utils.timeline merge)")
     p.add_argument("--timeline-mark-cycles", action="store_true",
                    default=False)
     p.add_argument("--log-level", default=None)
@@ -172,7 +178,8 @@ def launch_workers(command: Sequence[str], *, np_total: int,
                    prefix_output: bool = True,
                    connectivity_check: bool = True,
                    failure_info: Optional[dict] = None,
-                   services_hook=None) -> int:
+                   services_hook=None,
+                   timeline_dir: Optional[str] = None) -> int:
     """Start services + workers; wait; return exit code.  Local ranks run as
     child processes, remote ranks through ``ssh`` († gloo_run exec path).
 
@@ -202,19 +209,28 @@ def launch_workers(command: Sequence[str], *, np_total: int,
     # setdefault) so an explicitly passed secret wins over a stale one.
     os.environ["HVDTPU_SECRET"] = job_secret
 
-    # The stall-shutdown knob decides the controller's round-abort
-    # timeout; it may arrive via --config-file (worker-env only), so
-    # consult the worker env block before the launcher's own env.
-    stall_env = ((extra_env or {}).get("HVDTPU_STALL_SHUTDOWN_TIME_SECONDS")
-                 or os.environ.get("HVDTPU_STALL_SHUTDOWN_TIME_SECONDS")
-                 or os.environ.get("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"))
-    try:
-        stall_shutdown_s = float(stall_env) if stall_env else None
-    except ValueError:
-        stall_shutdown_s = None  # config parsing rejects it worker-side
+    # The stall knobs decide controller behavior (round-abort timeout;
+    # the stall inspector's straggler-attribution horizon); they may
+    # arrive via --config-file (worker-env only), so consult the worker
+    # env block before the launcher's own env, under every prefix the
+    # worker-side config parser accepts (config._PREFIXES).
+    def _stall_knob(suffix: str) -> Optional[float]:
+        for src in (extra_env or {}, os.environ):
+            for prefix in ("HVDTPU_", "HOROVOD_TPU_", "HOROVOD_"):
+                raw = src.get(prefix + suffix)
+                if raw:
+                    try:
+                        return float(raw)
+                    except ValueError:
+                        return None  # config rejects it worker-side
+        return None
+
+    stall_shutdown_s = _stall_knob("STALL_SHUTDOWN_TIME_SECONDS")
+    stall_warn_s = _stall_knob("STALL_CHECK_TIME_SECONDS")
     services = DriverServices(np_total, service_ip=service_ip,
                               secret=job_secret,
-                              stall_shutdown_s=stall_shutdown_s)
+                              stall_shutdown_s=stall_shutdown_s,
+                              stall_warn_s=stall_warn_s)
     if services_hook is not None:
         try:
             services_hook(services)
@@ -256,6 +272,9 @@ def launch_workers(command: Sequence[str], *, np_total: int,
     failed = threading.Event()
     exit_codes: dict[int, int] = {}
 
+    if timeline_dir:
+        os.makedirs(timeline_dir, exist_ok=True)
+
     def base_env(rank: int, local_rank: int) -> dict:
         # Full process env (ssh-launched workers inherit the launcher's
         # environment) + the shared control-plane block.
@@ -264,6 +283,11 @@ def launch_workers(command: Sequence[str], *, np_total: int,
             rank, local_rank,
             coordinator_addr=f"{coord_host}:{coord_port}",
             extra_env=extra_env))
+        if timeline_dir:
+            # One Timeline v2 file per rank; merged after the run into
+            # a single multi-lane Perfetto trace.
+            env["HVDTPU_TIMELINE"] = os.path.join(
+                timeline_dir, f"rank{rank}.json")
         return env
 
     def stream(worker: _Worker) -> None:
@@ -350,12 +374,39 @@ def launch_workers(command: Sequence[str], *, np_total: int,
                     for other in pending.values():
                         _terminate(other.proc)
             time.sleep(0.1)
+        if timeline_dir:
+            _merge_timeline_dir(timeline_dir, np_total, verbose=verbose)
         return code
     finally:
         for w in workers:
             if w.proc.poll() is None:
                 _terminate(w.proc)
         services.close()
+
+
+def _merge_timeline_dir(timeline_dir: str, np_total: int, *,
+                        verbose: bool = False) -> None:
+    """Best-effort post-run merge of the per-rank timelines written on
+    THIS host (ssh-launched ranks write on their own hosts) into
+    ``<dir>/merged.json`` — one trace, one pid lane per rank.  Only THIS
+    launch's ranks are merged: a reused dir (shrunk -np, elastic epoch)
+    may hold rank files from a previous larger run, and rebasing those
+    dead-epoch traces onto this run's clock would fabricate lanes."""
+    rank_files = [
+        path for r in range(np_total)
+        if os.path.exists(path := os.path.join(timeline_dir,
+                                               f"rank{r}.json"))]
+    if not rank_files:
+        return
+    from ..utils.timeline import merge_timelines
+    out = os.path.join(timeline_dir, "merged.json")
+    try:
+        summary = merge_timelines(out, rank_files)
+    except (OSError, ValueError) as e:
+        print(f"[launcher] timeline merge failed: {e}", file=sys.stderr)
+        return
+    print(f"[launcher] merged {len(summary['ranks'])} rank timeline(s) "
+          f"-> {out}", file=sys.stderr)
 
 
 def _run_probe_stage(hosts, services, *, my_ip: str, ssh_port: int,
@@ -503,7 +554,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     return launch_workers(command, np_total=args.num_proc,
                           hosts_spec=args.hosts, extra_env=extra_env,
                           ssh_port=args.ssh_port, verbose=args.verbose,
-                          connectivity_check=not args.no_connectivity_check)
+                          connectivity_check=not args.no_connectivity_check,
+                          timeline_dir=args.timeline_dir)
 
 
 def run_elastic(command: Sequence[str], args, extra_env: dict) -> int:
@@ -536,6 +588,9 @@ def run_elastic(command: Sequence[str], args, extra_env: dict) -> int:
             "ssh_port": args.ssh_port,
             "verbose": args.verbose,
             "connectivity_check": not args.no_connectivity_check,
+            # Per-epoch rank timelines share the dir; each relaunch
+            # overwrites rank files and refreshes merged.json.
+            "timeline_dir": args.timeline_dir,
         })
 
 

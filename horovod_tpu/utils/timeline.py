@@ -39,16 +39,24 @@ from __future__ import annotations
 import atexit
 import itertools
 import json
+import os
 import threading
 import time
 from typing import Optional
 
 
 class Timeline:
-    """Thread-safe Chrome-trace writer; no-op when ``path`` is None."""
+    """Thread-safe Chrome-trace writer; no-op when ``path`` is None.
+
+    ``rank`` (when known) is stamped into a ``clock_sync`` metadata
+    event together with the wall-clock epoch of the trace's t=0 — the
+    anchor :func:`merge_timelines` uses to rebase per-rank traces onto
+    one shared time axis so cross-rank skew is visually real.
+    """
 
     def __init__(self, path: Optional[str], *, mark_cycles: bool = False,
-                 flush_interval_s: float = 1.0) -> None:
+                 flush_interval_s: float = 1.0,
+                 rank: Optional[int] = None) -> None:
         self._path = path
         self._mark_cycles = mark_cycles
         self._flush_interval = flush_interval_s
@@ -58,9 +66,22 @@ class Timeline:
         self._start = time.monotonic()
         self._last_flush = self._start
         self._flow_ids = itertools.count(1)
+        self.rank = rank
         if path:
+            # hvdrun --timeline-dir names a directory only the launcher
+            # host pre-creates; ssh-launched ranks (and any user path)
+            # must not die in init() over a missing parent.
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
             self._fh = open(path, "w")
             self._fh.write("[\n")
+            # Merge anchor: wall-clock time of this trace's ts=0, plus
+            # the rank when the caller knows it (multi-process workers).
+            sync_args: dict = {"epoch_us": time.time() * 1e6}
+            if rank is not None:
+                sync_args["rank"] = int(rank)
+            self._emit({"name": "clock_sync", "ph": "M", "pid": 0,
+                        "tid": 0, "args": sync_args})
             # Crash/exit durability: an unclosed timeline still flushes
             # its tail at interpreter exit (close() unregisters this).
             atexit.register(self.close)
@@ -190,3 +211,149 @@ class Timeline:
             self._fh.close()
             self._fh = None
         atexit.unregister(self.close)
+
+
+# ---------------------------------------------------------------------------
+# Multi-rank merge: N per-rank Timeline files -> one Perfetto trace with
+# one pid lane per rank.  The reference's † timeline.cc writes one file
+# per process and leaves the join to the user; ``hvdrun --timeline-dir``
+# collects per-rank files and this merge rebases them onto one wall-clock
+# axis (via each file's clock_sync anchor), so cross-rank skew — who
+# enqueued late, whose DISPATCH lags — is directly visible in one load.
+# ---------------------------------------------------------------------------
+
+#: flow/async ids are remapped per input file in strides of this, so
+#: arrows never alias across ranks (each rank counts its own ids from 1).
+_FLOW_ID_STRIDE = 1 << 24
+
+_RANK_RE = None  # compiled lazily; avoids importing re on the hot path
+
+
+def load_trace_events(path: str) -> list:
+    """Read one Chrome-trace JSON file, tolerating the truncated-array
+    form a crashed run leaves behind (the closing ``]`` is optional in
+    the trace format, and Timeline relies on that for crash durability).
+    Accepts both the bare-array and ``{"traceEvents": [...]}`` shapes."""
+    with open(path) as fh:
+        raw = fh.read()
+    try:
+        data = json.loads(raw)
+    except ValueError:
+        data = json.loads(raw.rstrip().rstrip(",") + "\n]")
+    if isinstance(data, dict):
+        data = data.get("traceEvents", [])
+    return [ev for ev in data if isinstance(ev, dict)]
+
+
+def _infer_rank(path: str, events: list, fallback: int) -> int:
+    """A file's rank: the clock_sync stamp when present, else a
+    ``rank<N>`` hint in the filename, else the positional index."""
+    for ev in events:
+        if ev.get("name") == "clock_sync" and ev.get("ph") == "M":
+            r = ev.get("args", {}).get("rank")
+            if r is not None:
+                return int(r)
+            break
+    global _RANK_RE
+    if _RANK_RE is None:
+        import re
+        _RANK_RE = re.compile(r"rank[_-]?(\d+)")
+    m = _RANK_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else fallback
+
+
+def _epoch_us(events: list) -> Optional[float]:
+    for ev in events:
+        if ev.get("name") == "clock_sync" and ev.get("ph") == "M":
+            e = ev.get("args", {}).get("epoch_us")
+            if e is not None:
+                return float(e)
+    return None
+
+
+def merge_timelines(out_path: str, inputs: list) -> dict:
+    """Merge per-rank timeline files into ``out_path``.
+
+    - one **pid lane per rank** (pid = rank, with ``process_name`` /
+      ``process_sort_index`` metadata so Perfetto orders lanes by rank);
+    - timestamps **rebased onto one shared axis** via each file's
+      ``clock_sync`` wall-clock anchor (files without one keep their own
+      zero), so a rank that started its step late is visibly shifted;
+    - **counter tracks and flow arrows survive**: counter events move to
+      their rank's lane, and flow ids are remapped per rank so no arrow
+      aliases another rank's.
+
+    Returns a summary dict (ranks merged, event count, output path).
+    """
+    per_file = []
+    for i, path in enumerate(inputs):
+        events = load_trace_events(path)
+        per_file.append((_infer_rank(path, events, i),
+                         _epoch_us(events), events))
+    per_file.sort(key=lambda t: t[0])
+    anchors = [e for _, e, _ in per_file if e is not None]
+    base = min(anchors) if anchors else 0.0
+
+    merged: list = []
+    ranks = []
+    for idx, (rank, epoch, events) in enumerate(per_file):
+        ranks.append(rank)
+        offset = (epoch - base) if epoch is not None else 0.0
+        id_off = (idx + 1) * _FLOW_ID_STRIDE
+        merged.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "tid": 0, "args": {"name": f"rank {rank}"}})
+        merged.append({"name": "process_sort_index", "ph": "M",
+                       "pid": rank, "tid": 0,
+                       "args": {"sort_index": rank}})
+        for ev in events:
+            if ev.get("name") in ("trace_end", "process_name",
+                                  "process_sort_index"):
+                continue
+            ev = dict(ev)
+            ev["pid"] = rank
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + offset
+            if ev.get("ph") in ("s", "t", "f") and "id" in ev:
+                ev["id"] = int(ev["id"]) + id_off
+            merged.append(ev)
+
+    with open(out_path, "w") as fh:
+        fh.write("[\n")
+        for ev in merged:
+            fh.write(json.dumps(ev) + ",\n")
+        fh.write(json.dumps(
+            {"name": "trace_end", "ph": "M", "pid": 0, "tid": 0}) + "\n]\n")
+    return {"out": out_path, "ranks": ranks, "events": len(merged)}
+
+
+def main(argv: Optional[list] = None) -> int:
+    """CLI: ``python -m horovod_tpu.utils.timeline merge out.json
+    rank0.json rank1.json ...`` — see :func:`merge_timelines`."""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.utils.timeline",
+        description="Timeline tools (merge per-rank Chrome traces)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    m = sub.add_parser(
+        "merge", help="merge per-rank timeline files into one trace "
+                      "with one pid lane per rank")
+    m.add_argument("out", help="output trace path")
+    m.add_argument("inputs", nargs="+",
+                   help="per-rank timeline files (rank read from each "
+                        "file's clock_sync event, else from a rank<N> "
+                        "filename hint, else positional)")
+    args = p.parse_args(argv)
+    if args.cmd == "merge":
+        summary = merge_timelines(args.out, args.inputs)
+        print(f"merged {len(summary['ranks'])} rank timeline(s) "
+              f"{summary['ranks']} -> {summary['out']} "
+              f"({summary['events']} events)", file=sys.stderr)
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

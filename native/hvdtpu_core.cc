@@ -879,8 +879,32 @@ class Controller {
       resp += static_cast<char>(cov);
       const_cast<TensorState*>(st)->ranks_seen.clear();
     }
+    // Stalled entries carry attribution († stall_inspector.cc logs only
+    // the tensor name; here the coordinator also names WHICH required
+    // ranks never submitted, and for how long the tensor has waited):
+    //   "name \x02 missing_ranks_csv \x02 age_ms"
+    // The straggler rank is exactly the required-and-not-joined rank
+    // absent from ranks_seen — the bitmap the readiness check already
+    // walks, exposed instead of discarded.
     put_u32(&resp, static_cast<uint32_t>(stalled.size()));
-    for (auto* st : stalled) put_str(&resp, st->name);
+    for (auto* st : stalled) {
+      std::string item = st->name;
+      item += '\x02';
+      bool first = true;
+      for (uint32_t r = 0; r < size_; ++r) {
+        if (!RankRequired(*st, r)) continue;
+        if (st->ranks_seen.count(r) || joined_.count(r)) continue;
+        if (!first) item += ',';
+        first = false;
+        item += std::to_string(r);
+      }
+      item += '\x02';
+      item += std::to_string(
+          std::chrono::duration_cast<std::chrono::milliseconds>(
+              now - st->first_seen_time)
+              .count());
+      put_str(&resp, item);
+    }
     uint8_t all_joined = joined_.size() == size_ ? 1 : 0;
     resp += static_cast<char>(all_joined);
     put_u32(&resp, last_join_rank_);

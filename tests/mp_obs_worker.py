@@ -1,0 +1,128 @@
+"""Multi-process workers for the distributed observability plane.
+
+Modes (``HVDTPU_TEST_MODE``):
+
+- ``cluster`` (default, np=2): each rank records rank-distinct metric
+  traffic and publishes its snapshot; rank 0 aggregates via
+  ``hvd.cluster_metrics`` AND over HTTP (``/cluster`` on a live
+  endpoint), asserting both ranks' counters appear rank-labeled, the
+  cluster sum is right, and the exposition validates.
+- ``stall`` (np=4): ranks 0-2 submit an allreduce rank 3 withholds; the
+  submitting ranks must see straggler attribution naming rank 3 and the
+  tensor — in the shutdown error, and in the
+  ``horovod_tpu_straggler{rank,tensor}`` gauge — while rank 3 exits
+  cleanly.
+"""
+
+import os
+import sys
+import time
+import urllib.request
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=1"
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.obs import REGISTRY, aggregate, export, server  # noqa: E402
+
+
+def _cluster_family(snap, name):
+    for fam in snap:
+        if fam["name"] == name:
+            return fam
+    return None
+
+
+def cluster_mode(me: int, n: int) -> int:
+    REGISTRY.counter("obs_e2e_events_total", "e2e traffic").inc(me + 1)
+    REGISTRY.histogram("obs_e2e_lat_seconds", "e2e latency",
+                       buckets=(0.01, 0.1)).observe(0.05)
+    assert aggregate.publish_now(), "publisher not armed or KV unreachable"
+
+    if me == 0:
+        # Wait (bounded) for rank 1's publish to land, then assert the
+        # merged view through the in-process API...
+        deadline = time.monotonic() + 30.0
+        while True:
+            snap = hvd.cluster_metrics()
+            fam = _cluster_family(snap, "obs_e2e_events_total")
+            ranks = {s["labels"].get("rank", "") for s in fam["samples"]} \
+                if fam else set()
+            if {"0", "1"} <= ranks:
+                break
+            assert time.monotonic() < deadline, \
+                f"rank 1 snapshot never appeared (saw {ranks})"
+            time.sleep(0.2)
+        by_rank = {s["labels"]["rank"]: s["value"] for s in fam["samples"]
+                   if "rank" in s["labels"]}
+        assert by_rank["0"] == 1.0 and by_rank["1"] == 2.0, by_rank
+        [total] = [s["value"] for s in fam["samples"]
+                   if "rank" not in s["labels"]]
+        assert total == 3.0, total
+        # build_info self-identification from BOTH ranks, world size 2.
+        bi = _cluster_family(snap, "horovod_tpu_build_info")
+        bi_ranks = {s["labels"]["rank"] for s in bi["samples"]
+                    if s["value"] == 1.0}
+        assert {"0", "1"} <= bi_ranks, bi["samples"]
+        assert all(s["labels"]["size"] == "2" for s in bi["samples"]
+                   if s["value"] == 1.0), bi["samples"]
+        # ...and through the HTTP endpoint (the acceptance path).
+        srv = server.MetricsServer(0, addr="127.0.0.1")
+        try:
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/cluster",
+                timeout=10).read().decode()
+        finally:
+            srv.close()
+        export.validate_prometheus(text)
+        assert 'obs_e2e_events_total{rank="0"} 1' in text, text
+        assert 'obs_e2e_events_total{rank="1"} 2' in text, text
+        assert "obs_e2e_events_total 3" in text, text
+        assert "obs_e2e_lat_seconds_count 2" in text, text  # bucket merge
+        assert "horovod_tpu_cluster_ranks_reporting 2" in text, text
+        # Per-rank engine series prove real-subsystem metrics aggregate
+        # too, not just test-local families.
+        assert 'hvd_negotiate_wait_seconds_count{rank="1"}' in text, text
+    hvd.barrier()
+    print(f"rank {me}: CLUSTER-OK")
+    return 0
+
+
+def stall_mode(me: int, n: int) -> int:
+    if me < 3:
+        h = hvd.allreduce_async(
+            hvd.from_local(np.ones((1, 2), np.float32)),
+            name="t.straggle")
+        try:
+            hvd.synchronize(h)
+        except hvd.HorovodInternalError as e:
+            msg = str(e)
+            assert "t.straggle" in msg, msg
+            # The shutdown error must name the exact withholding rank.
+            assert "awaiting rank(s) 3" in msg, msg
+            text = hvd.metrics("prometheus")
+            assert 'horovod_tpu_straggler{rank="3",tensor="t.straggle"}' \
+                in text, text
+            print(f"rank {me}: STRAGGLER-OK")
+            return 0
+        print(f"rank {me}: FAIL no stall error")
+        return 1
+    time.sleep(6.0)
+    print(f"rank {me}: STRAGGLER-BYSTANDER-OK")
+    return 0
+
+
+def main() -> int:
+    hvd.init()
+    me, n = hvd.cross_rank(), hvd.cross_size()
+    mode = os.environ.get("HVDTPU_TEST_MODE", "cluster")
+    rc = cluster_mode(me, n) if mode == "cluster" else stall_mode(me, n)
+    hvd.shutdown()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,7 +103,9 @@ def test_flash_rejects_bad_kv_heads():
 
 def test_default_blocks_divisibility():
     # Per-length tuning from the round-4 fwd+bwd sweep (see module doc).
-    assert default_blocks(512) == (512, 256)
+    # S=512 follows the committed sweep's fastest point, 256x256 (parity
+    # with dense; the parity-is-the-decision rationale is in BASELINE.md).
+    assert default_blocks(512) == (256, 256)
     assert default_blocks(1024) == (512, 512)
     assert default_blocks(2048) == (512, 512)
     assert default_blocks(256) == (256, 256)

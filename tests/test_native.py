@@ -221,6 +221,11 @@ def test_stall_warning_reported():
         for t in ts: t.start()
         for t in ts: t.join(timeout=30)
         assert "grad.s" in out[0][1]
+        # Straggler attribution: the stall record names the withholding
+        # rank (1 never submitted) and carries the stall age.
+        info = out[0].stall_info["grad.s"]
+        assert info.missing_ranks == (1,), info
+        assert info.age_ms >= 100, info
         c0.close()
         c1.close()
 

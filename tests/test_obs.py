@@ -1,13 +1,19 @@
 """Observability plane: metrics registry, exposition, HTTP endpoint,
-Timeline v2 (counter + flow events), and the cross-layer wiring.
+Timeline v2 (counter + flow events), the cross-layer wiring, and the
+distributed plane (cross-rank aggregation, straggler attribution,
+multi-rank timeline merge).
 
 The registry/export tests run on private ``MetricRegistry`` instances so
 they are deterministic regardless of what the session's engine has
 already recorded into the process-wide default registry; the wiring
-tests drive the real engine/serving paths and only assert deltas.
+tests drive the real engine/serving paths and only assert deltas; the
+``integration``-marked tests launch real hvdrun jobs.
 """
 
 import json
+import os
+import subprocess
+import sys
 import threading
 import urllib.request
 
@@ -19,12 +25,14 @@ from horovod_tpu.obs import (
     REGISTRY,
     MetricError,
     MetricRegistry,
+    aggregate,
     export,
     server,
 )
-from horovod_tpu.utils.timeline import Timeline
+from horovod_tpu.utils.timeline import Timeline, merge_timelines
 
 N = 8
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 # ---------------------------------------------------------------------------
@@ -250,6 +258,216 @@ def test_engine_series_and_hvd_metrics_api(tmp_path):
     json.loads(hvd.metrics("json"))
     with pytest.raises(ValueError):
         hvd.metrics("xml")
+
+
+# ---------------------------------------------------------------------------
+# distributed plane: aggregation, /cluster, straggler attribution,
+# timeline merge
+# ---------------------------------------------------------------------------
+
+def test_merge_snapshots_sums_counters_and_labels_ranks():
+    regs = []
+    for r in range(2):
+        reg = MetricRegistry()
+        reg.counter("m_events_total", "ev", ("kind",)) \
+            .labels(kind="x").inc(r + 1)
+        reg.gauge("m_depth").set(r * 5)
+        reg.histogram("m_lat_seconds", buckets=(0.1, 1.0)) \
+            .observe(0.05 * (r + 1))
+        regs.append(reg)
+    snaps = [json.loads(aggregate.local_snapshot_blob(
+        r, 2, registry=reg).decode()) for r, reg in enumerate(regs)]
+    merged = aggregate.merge_snapshots(snaps)
+    text = export.to_prometheus(merged)
+    export.validate_prometheus(text)
+    assert 'm_events_total{kind="x",rank="0"} 1' in text
+    assert 'm_events_total{kind="x",rank="1"} 2' in text
+    assert 'm_events_total{kind="x"} 3' in text          # cluster sum
+    import re
+    assert 'm_depth{rank="0"} 0' in text                 # gauges per-rank
+    assert 'm_depth{rank="1"} 5' in text
+    assert not re.search(r"^m_depth \d", text, re.M)     # no gauge sum
+    assert 'm_lat_seconds_count{rank="0"} 1' in text
+    assert "m_lat_seconds_count 2" in text               # bucket merge
+    assert "horovod_tpu_cluster_ranks_reporting 2" in text
+    json.loads(export.to_json(merged))                   # strict JSON
+
+
+def test_merge_keeps_families_with_own_rank_label_distinct():
+    """A family that already owns a 'rank' label (the straggler gauge:
+    rank = the straggler) must not collapse into duplicate series when
+    several ranks report it — the reporting rank goes to 'from_rank'."""
+    regs = []
+    for r in range(2):
+        reg = MetricRegistry()
+        reg.gauge("straggler_age", "g", ("rank", "tensor")) \
+            .labels(rank="3", tensor="t").set(10.0 + r)
+        regs.append(reg)
+    merged = aggregate.merge_snapshots([
+        json.loads(aggregate.local_snapshot_blob(
+            r, 2, registry=reg).decode())
+        for r, reg in enumerate(regs)])
+    text = export.to_prometheus(merged)
+    export.validate_prometheus(text)
+    [fam] = [f for f in merged if f["name"] == "straggler_age"]
+    assert "from_rank" in fam["labelnames"]
+    series = {(s["labels"]["rank"], s["labels"]["from_rank"]): s["value"]
+              for s in fam["samples"]}
+    assert series == {("3", "0"): 10.0, ("3", "1"): 11.0}
+
+
+def test_merge_skips_cluster_histogram_on_divergent_buckets():
+    r0, r1 = MetricRegistry(), MetricRegistry()
+    r0.histogram("h_seconds", buckets=(0.1, 1.0)).observe(0.5)
+    r1.histogram("h_seconds", buckets=(0.2, 2.0)).observe(0.5)
+    merged = aggregate.merge_snapshots([
+        json.loads(aggregate.local_snapshot_blob(
+            r, 2, registry=reg).decode())
+        for r, reg in enumerate((r0, r1))])
+    [fam] = [f for f in merged if f["name"] == "h_seconds"]
+    # per-rank series survive; no merged (rank-less) series is fabricated
+    # from incompatible bucket layouts.
+    assert all("rank" in s["labels"] for s in fam["samples"])
+    export.validate_prometheus(export.to_prometheus(merged))
+
+
+def test_cluster_metrics_single_process_world():
+    """No KV store: the cluster view is the local registry labeled
+    rank=<this process> — world size 1, same shape as a real cluster."""
+    snap = hvd.cluster_metrics()
+    fams = {f["name"]: f for f in snap}
+    assert "hvd_collectives_total" in fams
+    assert all("rank" in s["labels"]
+               for s in fams["hvd_engine_queue_depth"]["samples"])
+    bi = fams["horovod_tpu_build_info"]
+    live = [s for s in bi["samples"] if s["value"] == 1.0]
+    assert live and live[0]["labels"]["version"] == hvd.__version__
+    text = hvd.cluster_metrics("prometheus")
+    export.validate_prometheus(text)
+    assert "horovod_tpu_cluster_ranks_reporting 1" in text
+    with pytest.raises(ValueError):
+        hvd.cluster_metrics("xml")
+
+
+def test_cluster_endpoint_served_next_to_metrics():
+    """/cluster rides the same server as /metrics once init armed the
+    provider (the conftest session already ran hvd.init())."""
+    srv = server.MetricsServer(0, addr="127.0.0.1")
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        text = urllib.request.urlopen(
+            f"{base}/cluster", timeout=10).read().decode()
+        export.validate_prometheus(text)
+        assert 'rank="0"' in text
+        blob = json.loads(urllib.request.urlopen(
+            f"{base}/cluster.json", timeout=10).read().decode())
+        assert any(m["name"] == "horovod_tpu_cluster_size"
+                   for m in blob["metrics"])
+    finally:
+        srv.close()
+
+
+def test_timeline_merge_one_pid_lane_per_rank(tmp_path):
+    import time as _time
+    paths = []
+    for r in range(2):
+        p = tmp_path / f"rank{r}.json"
+        with Timeline(str(p), rank=r) as tl:
+            tl.start_activity("grad.0", "QUEUE")
+            fid = tl.new_flow()
+            tl.flow_start("grad.0", fid)
+            tl.end_activity("grad.0")
+            tl.start_activity("grad.0", "DISPATCH")
+            tl.flow_end("grad.0", fid)
+            tl.counter("hvd.engine", {"queue_depth": r})
+            tl.end_activity("grad.0")
+        paths.append(str(p))
+        _time.sleep(0.02)
+    out = tmp_path / "merged.json"
+    summary = merge_timelines(str(out), paths)
+    assert summary["ranks"] == [0, 1]
+    events = json.loads(out.read_text())
+    # one pid lane per rank, named and sorted
+    assert {e["pid"] for e in events if e["ph"] in "BEC"} == {0, 1}
+    names = {e["pid"]: e["args"]["name"] for e in events
+             if e.get("name") == "process_name"}
+    assert names == {0: "rank 0", 1: "rank 1"}
+    # flow arrows survive per rank without aliasing across ranks
+    flow = {}
+    for e in events:
+        if e["ph"] in ("s", "f"):
+            flow.setdefault(e["pid"], {})[e["ph"]] = e["id"]
+    assert flow[0]["s"] == flow[0]["f"]
+    assert flow[1]["s"] == flow[1]["f"]
+    assert flow[0]["s"] != flow[1]["s"]
+    # counter tracks land in their rank's lane
+    assert {e["pid"] for e in events if e["ph"] == "C"} == {0, 1}
+    # clock_sync rebase: rank 1 started later, so its spans sit later on
+    # the shared axis even though both files' own ts start near 0.
+    b0 = min(e["ts"] for e in events if e["pid"] == 0 and e["ph"] == "B")
+    b1 = min(e["ts"] for e in events if e["pid"] == 1 and e["ph"] == "B")
+    assert b1 > b0
+
+
+def test_timeline_merge_cli_accepts_truncated_input(tmp_path):
+    p0 = tmp_path / "rank0.json"
+    tl = Timeline(str(p0), rank=0)
+    tl.start_activity("t", "QUEUE")
+    tl.flush()                      # crash-truncated: no closing bracket
+    p1 = tmp_path / "rank1.json"
+    with Timeline(str(p1), rank=1) as tl1:
+        tl1.start_activity("t", "QUEUE")
+        tl1.end_activity("t")
+    out = tmp_path / "m.json"
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.utils.timeline", "merge",
+         str(out), str(p0), str(p1)],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "PYTHONPATH":
+             REPO + os.pathsep + os.environ.get("PYTHONPATH", "")})
+    assert res.returncode == 0, res.stderr
+    events = json.loads(out.read_text())
+    assert {e["pid"] for e in events if e["ph"] == "B"} == {0, 1}
+    tl.close()
+
+
+def _hvdrun(np_, extra_env=None, timeout=240):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # workers force CPU
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_), "--",
+         sys.executable, os.path.join(REPO, "tests", "mp_obs_worker.py")],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+@pytest.mark.integration
+def test_cluster_view_aggregates_both_ranks_np2():
+    """Acceptance: rank 0's /cluster contains both ranks' counters summed
+    and the rank label present, and validates as Prometheus."""
+    res = _hvdrun(2)
+    assert res.returncode == 0, res.stdout + res.stderr
+    for r in range(2):
+        assert f"rank {r}: CLUSTER-OK" in res.stdout, res.stdout
+
+
+@pytest.mark.integration
+def test_straggler_attribution_np4():
+    """Acceptance: a deliberately withheld allreduce at np=4 produces a
+    stall report naming the exact lagging rank and tensor."""
+    res = _hvdrun(4, extra_env={
+        "HVDTPU_TEST_MODE": "stall",
+        "HVDTPU_STALL_CHECK_TIME_SECONDS": "2",
+        "HVDTPU_STALL_SHUTDOWN_TIME_SECONDS": "4",
+    })
+    assert res.returncode == 0, res.stdout + res.stderr
+    for r in range(3):
+        assert f"rank {r}: STRAGGLER-OK" in res.stdout, res.stdout
+    assert "rank 3: STRAGGLER-BYSTANDER-OK" in res.stdout, res.stdout
+    # the actionable log line names rank + tensor (+ age)
+    assert "Straggler: rank(s) 3 have not submitted tensor " \
+        "'t.straggle'" in res.stdout, res.stdout
 
 
 def test_serving_request_metrics_reach_registry():
