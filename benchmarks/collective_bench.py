@@ -12,6 +12,21 @@ Run directly (``python -m benchmarks.collective_bench``) for a sweep table,
 or call :func:`allreduce_busbw` for one point.  On a single chip there is
 no inter-chip wire; the sweep still validates dispatch overhead and HBM
 throughput, and the same harness scales to any mesh.
+
+Wire precision (``--wire-precision fp32,bf16,int8,...``): sweeps the
+engine's wire modes (ops/reduction.py) and reports per mode
+
+- ``dispatch_GBs`` / ``busbw_GBs`` — measured wall-clock on the LOGICAL
+  payload (what the caller's gradients experience);
+- ``wire_reduction`` — analytic interconnect bytes saved vs the fp32
+  ring (``reduction.ring_wire_bytes``), the number that transfers to a
+  bandwidth-bound interconnect (int8 ≈ 2.6x at the default block).
+
+Read both columns together: on TPU wire time dominates so
+``wire_reduction`` converts to wall-clock (EQuARX measures ~2x); the CPU
+rig's collectives are shared-memory and byte-width-insensitive while its
+8x-oversubscribed cores inflate the quantize arithmetic, so wall-clock
+there does NOT improve — see docs/performance.md "Wire precision".
 """
 
 from __future__ import annotations
@@ -35,11 +50,12 @@ def jax_device_get_first(x):
 
 
 def allreduce_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
-                    dtype="float32") -> dict:
+                    dtype="float32", wire_precision: str = "fp32") -> dict:
     """One allreduce bandwidth point on the current global mesh."""
     import jax
     import jax.numpy as jnp
     import horovod_tpu as hvd
+    from horovod_tpu.ops import reduction as R
 
     n = hvd.size()
     itemsize = np.dtype(dtype).itemsize
@@ -47,22 +63,40 @@ def allreduce_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
     x = hvd.per_rank_from_fn(
         lambda r: np.full((numel,), float(r + 1), dtype))
     from horovod_tpu.ops import collectives as C
-    out = C.allreduce(x, hvd.Sum)
+    cfg = hvd.global_state().config
+    # Report what actually runs: the resolver may downgrade (size floor,
+    # single-rank mesh, ...) — a row must never claim quantized savings
+    # for an allreduce that executed at fp32.
+    resolved = R.resolve_precision(wire_precision, hvd.Sum, np.dtype(dtype),
+                                   nbytes, cfg, n)
+    out = C.allreduce(x, hvd.Sum, precision=wire_precision)
     _fence(out)
     for _ in range(warmup):
-        out = C.allreduce(x, hvd.Sum)
+        out = C.allreduce(x, hvd.Sum, precision=wire_precision)
     _fence(out)
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = C.allreduce(x, hvd.Sum)
+        out = C.allreduce(x, hvd.Sum, precision=wire_precision)
     _fence(out)
     dt = (time.perf_counter() - t0) / iters
     payload = numel * itemsize
     algbw = payload / dt
     row = {"op": "allreduce", "bytes": payload, "time_us": dt * 1e6,
-           "algbw_GBs": algbw / 1e9, "ranks": n}
+           "algbw_GBs": algbw / 1e9, "ranks": n,
+           "wire_precision": resolved}
+    if resolved != wire_precision:
+        row["requested_precision"] = wire_precision
+    if resolved != "fp32":
+        block = cfg.quant_block_size
+        wire = R.ring_wire_bytes(resolved, payload, n, block, itemsize)
+        wire_fp32 = R.ring_wire_bytes("fp32", payload, n, block, itemsize)
+        row["wire_bytes"] = wire
+        row["wire_reduction"] = round(wire_fp32 / wire, 2) if wire else None
     if n > 1:
         row["busbw_GBs"] = algbw * (2 * (n - 1) / n) / 1e9
+        # effective GB/s on the logical payload — same number the n==1
+        # branch labels dispatch_GBs; kept under one key for mode sweeps.
+        row["dispatch_GBs"] = algbw / 1e9
     else:
         # One rank has no wire: this is dispatch + HBM throughput, and it
         # must not wear a bus-bandwidth label (round-3 verdict finding).
@@ -70,10 +104,11 @@ def allreduce_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
     return row
 
 
-def sweep(sizes=None, **kw) -> list[dict]:
+def sweep(sizes=None, modes=("fp32",), **kw) -> list[dict]:
     if sizes is None:
         sizes = [1 << p for p in range(12, 27, 2)]   # 4 KB .. 64 MB
-    return [allreduce_busbw(s, **kw) for s in sizes]
+    return [allreduce_busbw(s, wire_precision=m, **kw)
+            for m in modes for s in sizes]
 
 
 def main() -> None:
@@ -83,22 +118,53 @@ def main() -> None:
                     help="force an N-device virtual CPU rig (multi-rank "
                     "busbw with real XLA collectives + protocol overhead; "
                     "numbers are CPU-memory-bound, not ICI)")
+    ap.add_argument("--wire-precision", default="fp32", metavar="MODES",
+                    help="comma-separated wire modes to sweep "
+                    "(fp32,bf16,fp16,int8,fp8); each mode reports "
+                    "dispatch_GBs (measured) and wire_reduction (analytic "
+                    "interconnect saving vs fp32)")
     args = ap.parse_args()
     if args.cpu_devices:
         from horovod_tpu.utils.cpurig import force_cpu_platform
         force_cpu_platform(args.cpu_devices)
     import horovod_tpu as hvd
     hvd.init()
-    rows = sweep()
+    # Benchmarks opt out of the size floor: the point is to measure every
+    # mode at every size, not to second-guess the resolver.
+    hvd.global_state().config.quant_min_bytes = 0
+    modes = [m.strip() for m in args.wire_precision.split(",") if m.strip()]
+    rows = sweep(modes=modes)
     for r in rows:
         print(json.dumps(r))
     key = "busbw_GBs" if "busbw_GBs" in rows[0] else "dispatch_GBs"
-    best = max(rows, key=lambda r: r[key])
+    by_mode = {m: [r for r in rows if r["wire_precision"] == m]
+               for m in modes}
+    base_rows = by_mode.get("fp32") or rows
+    best = max(base_rows, key=lambda r: r[key])
     metric = ("allreduce_busbw_peak" if key == "busbw_GBs"
               else "allreduce_dispatch_peak")
     print(json.dumps({"metric": metric, "value": round(best[key], 2),
                       "unit": "GB/s", "at_bytes": best["bytes"],
                       "ranks": best["ranks"]}))
+    if len(modes) > 1 and "fp32" in by_mode:
+        # Mode comparison at >= 4 MB: measured wall-clock ratio AND the
+        # analytic wire saving, per mode.
+        base = {r["bytes"]: r for r in by_mode["fp32"]}
+        for m in modes:
+            if m == "fp32":
+                continue
+            big = [r for r in by_mode[m]
+                   if r["bytes"] >= (1 << 22) and r["bytes"] in base]
+            if not big:
+                continue
+            ratios = [r["dispatch_GBs"] / base[r["bytes"]]["dispatch_GBs"]
+                      for r in big]
+            print(json.dumps({
+                "metric": f"allreduce_{m}_vs_fp32_at_4MB_plus",
+                "measured_dispatch_ratio": round(float(np.mean(ratios)), 3),
+                "wire_reduction": big[0].get("wire_reduction"),
+                "ranks": big[0]["ranks"],
+            }))
 
 
 if __name__ == "__main__":

@@ -59,7 +59,6 @@ from .ops import (  # noqa: F401
     Max,
     Product,
     Adasum,
-    grouped_allreduce,
     per_rank,
     per_rank_from_fn,
     to_numpy,
@@ -71,6 +70,8 @@ from .ops.collectives import (  # noqa: F401
 )
 from .ops.engine import Handle, HorovodInternalError, TensorTableEntry
 from .ops import collectives as _C
+from .ops import reduction as _R
+from .ops.compression import Compression  # noqa: F401  (hvd.Compression.*)
 
 __version__ = "0.1.0"
 
@@ -112,18 +113,58 @@ def _sync_via_engine_or_direct(direct_fn, verb: str, payload: Any,
     return direct_fn()
 
 
+def _resolve_entry_precision(compression, payload, op, process_set) -> str:
+    """Wire mode for an engine entry, resolved at enqueue time.
+
+    Deterministic in (compression, op, dtype, per-rank bytes, config) so
+    every rank building the same entry at the same program point derives
+    the same mode — the property fusion groups and negotiation
+    signatures rely on (the same reason DistributedOptimizer latches
+    the fusion threshold).  Delegates to the canonical convention in
+    ops/collectives so enqueue-time and dispatch-time resolution can
+    never drift apart.
+    """
+    state = global_state()
+    if not state.initialized:
+        return _R.as_wire_mode(compression) or "fp32"
+    mesh, axis = _C._mesh_axis(process_set)
+    return _C._resolve_precision(_R.as_wire_mode(compression), op, payload,
+                                 mesh.shape[axis])
+
+
 def allreduce(x: Any, op: ReduceOp = Average, *,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
-              process_set=None) -> Any:
+              compression=None, process_set=None) -> Any:
     """Reduce a per-rank tensor across ranks; result replicated
-    († ``hvd.allreduce``)."""
+    († ``hvd.allreduce``).
+
+    ``compression`` selects the wire precision: a ``hvd.Compression.*``
+    entry or a mode string (``"fp32"``/``"bf16"``/``"fp16"``/``"int8"``/
+    ``"fp8"``); None defers to ``HOROVOD_TPU_WIRE_PRECISION``.
+    """
     payload = _C.as_per_rank(x, process_set)
+    mode = _resolve_entry_precision(compression, payload, op, process_set)
     return _sync_via_engine_or_direct(
         lambda: _C.allreduce(payload, op, prescale_factor=prescale_factor,
                              postscale_factor=postscale_factor,
-                             process_set=process_set),
+                             precision=mode, process_set=process_set),
         "allreduce", payload, op=op, prescale=prescale_factor,
-        postscale=postscale_factor, process_set=process_set)
+        postscale=postscale_factor, precision=mode,
+        process_set=process_set)
+
+
+def grouped_allreduce(xs: Sequence[Any], op: ReduceOp = Average, *,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      compression=None, process_set=None) -> list:
+    """Fused allreduce of several tensors in one program/collective
+    († ``hvd.grouped_allreduce``).  ``compression`` as in
+    :func:`allreduce`; the wire mode resolves against the group's total
+    bytes (one quantized program covers the whole explicit group)."""
+    return _C.grouped_allreduce(
+        xs, op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+        precision=_R.as_wire_mode(compression), process_set=process_set)
 
 
 def allgather(x: Any, process_set=None) -> Any:
@@ -321,17 +362,23 @@ def allreduce_async(x: Any, op: ReduceOp = Average, *,
                     name: Optional[str] = None,
                     prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0,
+                    compression=None,
                     process_set=None) -> Handle:
     """Enqueue an allreduce; returns a :class:`Handle` immediately.
 
     Entries enqueued within one engine cycle fuse into a single compiled
     collective (the fusion-buffer path) — this is the hot call
-    ``DistributedOptimizer`` gradient hooks use.
+    ``DistributedOptimizer`` gradient hooks use.  Same-``compression``
+    entries fuse together; the wire mode applies to the whole fused
+    buffer (see :mod:`horovod_tpu.ops.reduction`).
     """
+    payload = _C.as_per_rank(x, process_set)
     entry = TensorTableEntry(
         name=_auto_name("allreduce", name), verb="allreduce",
-        payload=_C.as_per_rank(x, process_set), op=op,
+        payload=payload, op=op,
         prescale=prescale_factor, postscale=postscale_factor,
+        precision=_resolve_entry_precision(compression, payload, op,
+                                           process_set),
         process_set=process_set)
     return _engine().enqueue(entry)
 
@@ -370,6 +417,7 @@ def grouped_allreduce_async(xs: Sequence[Any], op: ReduceOp = Average, *,
                             name: Optional[str] = None,
                             prescale_factor: float = 1.0,
                             postscale_factor: float = 1.0,
+                            compression=None,
                             process_set=None) -> list[Handle]:
     """Enqueue several allreduces at once († ``hvd.grouped_allreduce_async``,
     v0.21).  The entries share one engine cycle, so they fuse into a single
@@ -378,10 +426,13 @@ def grouped_allreduce_async(xs: Sequence[Any], op: ReduceOp = Average, *,
     handles = []
     eng = _engine()
     for i, x in enumerate(xs):
+        payload = _C.as_per_rank(x, process_set)
         entry = TensorTableEntry(
             name=f"{base}.{i}", verb="allreduce",
-            payload=_C.as_per_rank(x, process_set), op=op,
+            payload=payload, op=op,
             prescale=prescale_factor, postscale=postscale_factor,
+            precision=_resolve_entry_precision(compression, payload, op,
+                                               process_set),
             process_set=process_set)
         handles.append(eng.enqueue(entry))
     return handles

@@ -33,6 +33,15 @@ def _parse_platform(v: str) -> str:
     return lv
 
 
+def _parse_wire_precision(v: str) -> str:
+    lv = v.strip().lower()
+    if lv not in ("fp32", "bf16", "fp16", "int8", "fp8"):
+        raise ValueError(
+            "wire precision must be one of fp32/bf16/fp16/int8/fp8, "
+            f"got {v!r}")
+    return lv
+
+
 def _parse_bool(v: str) -> bool:
     lv = v.strip().lower()
     if lv in _TRUE:
@@ -59,6 +68,18 @@ class Config:
     # Reference default 5 ms; on TPU the dispatch itself is async so short
     # cycles are cheap.
     cycle_time_ms: float = 5.0
+
+    # --- wire precision (ops/reduction.py; EQuARX arXiv:2506.17615) ---
+    # Default wire mode for engine allreduces: fp32 (off), bf16/fp16
+    # (cast wire), int8/fp8 (block-scaled quantized).  Per-call override:
+    # ``hvd.allreduce(t, compression=...)``.  Non-float payloads,
+    # non-sum reductions and sub-floor tensors always fall back to fp32.
+    wire_precision: str = "fp32"
+    # Block size for the per-block absmax scales of int8/fp8 modes.
+    quant_block_size: int = 512
+    # Payloads below this many bytes (per rank) never quantize — the
+    # scale traffic and encode pass outweigh the wire saving.
+    quant_min_bytes: int = 65536
 
     # --- response/dispatch cache († response_cache.cc) ---
     # Capacity of the compiled-collective dispatch cache (signature -> jitted
@@ -128,6 +149,9 @@ class Config:
 _ENV_TABLE = [
     ("fusion_threshold", "FUSION_THRESHOLD", int),
     ("cycle_time_ms", "CYCLE_TIME", float),
+    ("wire_precision", "WIRE_PRECISION", _parse_wire_precision),
+    ("quant_block_size", "QUANT_BLOCK_SIZE", int),
+    ("quant_min_bytes", "QUANT_MIN_BYTES", int),
     ("cache_capacity", "CACHE_CAPACITY", int),
     ("autotune", "AUTOTUNE", _parse_bool),
     ("autotune_log", "AUTOTUNE_LOG", str),

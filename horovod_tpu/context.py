@@ -234,6 +234,10 @@ def init(
         # who/where/what-version without joining against launch logs.
         try:
             _arm_obs_plane()
+            # Publish the engine-default wire precision as a gauge so a
+            # scrape answers "is this job quantizing its allreduces".
+            from .ops import reduction as _R
+            _R.publish_mode_gauge(cfg.wire_precision)
         except Exception as e:  # telemetry must never fail init
             log.warning("obs plane not armed: %s", e)
 

@@ -10,11 +10,29 @@ and reduces N ranks by recursive pairwise combination (the reference uses
 recursive vector-halving over MPI; Maleki et al., "Scaling Distributed
 Training with Adaptive Summation", arXiv:2006.02924).
 
-TPU-native design: the whole log2(N)-level combination tree is one compiled
-program.  Each level is expressed with an ``all_gather`` of the current
-per-rank vectors followed by an in-register pairwise combine — XLA schedules
-the gather on ICI and fuses the (tiny) dot/norm arithmetic.  The tree is
-unrolled at trace time (N is static), keeping control flow compiler-friendly.
+TPU-native design, v2: one compiled program riding the reduction-algebra
+decomposition (:func:`ops.reduction.build_decomposed_allreduce`) with
+:class:`ops.reduction.AdasumAlgebra` as the combine hook —
+
+    all_to_all (each device keeps shard *i* of every rank's vector)
+      -> pairwise projection tree over shards, each pair's dot/norm
+         scalars psum'd across the mesh so projections use FULL-vector
+         inner products
+      -> all_gather of the combined shard.
+
+Memory bound: O(numel + n) per device — the ``all_to_all`` hands every
+device ``numel`` total elements (n shards of numel/n) plus 3 scalars per
+tree level.  The previous implementation gathered all N full vectors to
+every rank (``all_gather`` then a Python-unrolled tree): O(N * numel)
+per device, which capped Adasum at 1/N of the fusion-buffer sizes plain
+allreduce could take.  Wire cost also drops from (n-1)*numel per device
+to ~2*numel.
+
+The wire stays full precision deliberately: quantization error is
+amplified by the dot-product projections (a block-scaled wire perturbs
+a.b by up to |a||b|/qmax, flipping the combine coefficients near
+orthogonality), so Adasum entries always resolve to the fp32 wire mode
+— see ``ops.reduction.resolve_precision``.
 """
 
 from __future__ import annotations
@@ -23,47 +41,25 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
-from jax import lax
-from ..jaxcompat import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
 
 from . import collectives as C
+from .reduction import AdasumAlgebra, build_decomposed_allreduce
 
 
 def _pair_combine(a: jax.Array, b: jax.Array) -> jax.Array:
-    """Combine two flat gradient vectors per the Adasum rule."""
-    orig_dtype = a.dtype
-    a32 = a.astype(jnp.float32)
-    b32 = b.astype(jnp.float32)
-    dot = jnp.sum(a32 * b32)
-    na = jnp.sum(a32 * a32)
-    nb = jnp.sum(b32 * b32)
-    # Zero-norm guard: if either side is all zeros, fall back to plain sum
-    # (matches reference behavior where projection terms vanish).
-    ca = jnp.where(na > 0, 1.0 - dot / (2.0 * jnp.maximum(na, 1e-30)), 1.0)
-    cb = jnp.where(nb > 0, 1.0 - dot / (2.0 * jnp.maximum(nb, 1e-30)), 1.0)
-    return (ca * a32 + cb * b32).astype(orig_dtype)
+    """Combine two full (undistributed) flat vectors per the Adasum rule.
+
+    Kept for in-context callers (optim/distributed's mapped train steps)
+    that hold whole vectors per rank; the engine path combines shards via
+    :class:`AdasumAlgebra`, whose per-pair math is identical with the
+    dot/norm scalars psum'd across shards.
+    """
+    return AdasumAlgebra._pair_combine(a, b, axis=None)
 
 
-def _build_adasum(mesh: Mesh, axis: str, shape: tuple[int, ...]):
-    n = mesh.shape[axis]
-
-    def kernel(v):  # [1, *shape] per device
-        flat = lax.all_gather(v[0].reshape(-1), axis, axis=0)  # [n, numel]
-        vecs = [flat[i] for i in range(n)]
-        # Pairwise combination tree (unrolled; n is static).
-        while len(vecs) > 1:
-            nxt = []
-            for i in range(0, len(vecs) - 1, 2):
-                nxt.append(_pair_combine(vecs[i], vecs[i + 1]))
-            if len(vecs) % 2:
-                nxt.append(vecs[-1])
-            vecs = nxt
-        return vecs[0].reshape(shape)
-
-    fn = shard_map(kernel, mesh=mesh, in_specs=P(axis), out_specs=P(),
-                   check_vma=False)
-    return jax.jit(fn)
+def _build_adasum(mesh, axis: str, shape: tuple[int, ...], dtype):
+    return build_decomposed_allreduce(
+        mesh, axis, AdasumAlgebra(), shape, dtype)
 
 
 def adasum_allreduce(x: Any, process_set=None) -> jax.Array:
@@ -76,6 +72,6 @@ def adasum_allreduce(x: Any, process_set=None) -> jax.Array:
     x = C.as_per_rank(x, process_set)
     shape = x.shape[1:]
     key = C._sig(mesh, axis, "adasum", x.dtype.name, x.shape)
-    fn = C._cache.get_or_build(key,
-                               lambda: _build_adasum(mesh, axis, shape))
+    fn = C._cache.get_or_build(
+        key, lambda: _build_adasum(mesh, axis, shape, x.dtype))
     return fn(x)

@@ -281,16 +281,26 @@ def _build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
                              numels: tuple[int, ...],
                              shapes: tuple[tuple[int, ...], ...],
                              prescale: float, postscale: float,
-                             hier: Optional[tuple[int, int]] = None):
+                             hier: Optional[tuple[int, int]] = None,
+                             mode: str = "fp32", block: int = 512,
+                             dtype=None):
     """One fused program for many tensors: flatten → concat → reduce → split.
 
     This *is* the fusion buffer († ``fusion_buffer_manager.cc``): instead of
     memcpying into a 64 MB scratch allocation, the flatten/concat lives inside
     the compiled program where XLA fuses it with the collective, and HBM
     layout is the compiler's problem.  With ``hier`` set, the fused buffer
-    rides the two-level path.
+    rides the two-level path; with ``mode`` != fp32 it rides the
+    wire-precision path (quantization applies to the whole fused buffer,
+    so per-block scale overhead amortizes across the group's tensors).
     """
-    if hier is not None:
+    if mode != "fp32":
+        from . import reduction as R
+        total = int(sum(numels))
+        reduce_one = R.build_allreduce(
+            mesh, axis, op, mode, (total,), dtype, prescale, postscale,
+            block)
+    elif hier is not None:
         reduce_one = _build_hier_allreduce(
             ctx_mod.global_state(), op, hier[0], hier[1], prescale, postscale)
     else:
@@ -413,19 +423,56 @@ def _sig(mesh: Mesh, axis: str, *extras) -> tuple:
     return (id(mesh), axis) + extras
 
 
+def _resolve_precision(precision: str, op: ReduceOp, x: jax.Array,
+                       n: int) -> str:
+    """Engine-default + per-call wire mode -> the mode actually built.
+
+    ``x`` is the per-rank tensor ([n, *shape]); the size floor applies
+    to ONE rank's payload, matching the engine's per-entry accounting.
+    This is THE canonical resolution convention: the API layer's
+    enqueue-time resolution (horovod_tpu._resolve_entry_precision) calls
+    here, and dispatch re-resolves through the same function — the two
+    must agree byte-for-byte or negotiated metas and compiled programs
+    diverge across ranks.
+    """
+    from . import reduction as R
+    cfg = ctx_mod.global_state().config
+    nbytes = int(x.size * x.dtype.itemsize) // max(1, n)
+    return R.resolve_precision(precision, op, x.dtype, nbytes, cfg, n)
+
+
 def allreduce(x: Any, op: ReduceOp = ReduceOp.AVERAGE, *,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
-              process_set=None) -> jax.Array:
+              precision: str = "", process_set=None) -> jax.Array:
     """Reduce a per-rank tensor across ranks; result replicated.
 
     † ``EnqueueTensorAllreduce`` / ``MPI_Allreduce`` / ``ncclAllReduce``;
     prescale/postscale as in the reference's allreduce signature.
+    ``precision`` selects the wire mode (see :mod:`ops.reduction`);
+    empty defers to ``config.wire_precision`` and falls back to fp32
+    whenever the mode cannot apply (non-float, non-sum, too small).
     """
     if op is ReduceOp.ADASUM:
         from . import adasum
         return adasum.adasum_allreduce(x, process_set=process_set)
     mesh, axis = _mesh_axis(process_set)
     x = as_per_rank(x, process_set)
+    n = mesh.shape[axis]
+    mode = _resolve_precision(precision, op, x, n)
+    if mode != "fp32":
+        from . import reduction as R
+        cfg = ctx_mod.global_state().config
+        block = cfg.quant_block_size
+        key = _sig(mesh, axis, "allreduce", op, x.dtype.name, x.shape,
+                   mode, block,
+                   float(prescale_factor), float(postscale_factor))
+        fn = _cache.get_or_build(
+            key, lambda: R.build_allreduce(
+                mesh, axis, op, mode, x.shape[1:], x.dtype,
+                float(prescale_factor), float(postscale_factor), block))
+        R.account_wire(mode, int(x.size * x.dtype.itemsize) // n, n, block,
+                       itemsize=x.dtype.itemsize)
+        return fn(x)
     split = _hier_split(process_set)
     if split is not None and (
             op is ReduceOp.SUM
@@ -453,11 +500,14 @@ def allreduce(x: Any, op: ReduceOp = ReduceOp.AVERAGE, *,
 def grouped_allreduce(xs: Sequence[Any], op: ReduceOp = ReduceOp.AVERAGE, *,
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0,
+                      precision: str = "",
                       process_set=None) -> list[jax.Array]:
     """Fused allreduce of several tensors in one program/collective.
 
     † grouped allreduce (v0.21) and the implicit fusion of
-    † ``fusion_buffer_manager.cc``.
+    † ``fusion_buffer_manager.cc``.  ``precision`` applies the wire mode
+    to the whole fused buffer (the engine fuses same-precision entries
+    together, so one quantized program covers the group).
     """
     if not xs:
         return []
@@ -472,25 +522,43 @@ def grouped_allreduce(xs: Sequence[Any], op: ReduceOp = ReduceOp.AVERAGE, *,
             sub = grouped_allreduce([arrs[i] for i in idxs], op,
                                     prescale_factor=prescale_factor,
                                     postscale_factor=postscale_factor,
+                                    precision=precision,
                                     process_set=process_set)
             for i, r in zip(idxs, sub):
                 out[i] = r
         return out  # type: ignore[return-value]
     shapes = tuple(a.shape[1:] for a in arrs)
     numels = tuple(int(np.prod(s, dtype=np.int64)) if s else 1 for s in shapes)
+    n = mesh.shape[axis]
+    # The fused buffer is quantized as one payload.  DIRECT callers of
+    # this function resolve against the group's total bytes (small
+    # tensors sharing a big explicit group can quantize together); the
+    # ENGINE path instead resolves per-entry at enqueue — deterministic
+    # across ranks — and passes a concrete mode through, so the size
+    # floor there gates each tensor individually.
+    from . import reduction as R
+    cfg = ctx_mod.global_state().config
+    total_bytes = int(sum(numels)) * arrs[0].dtype.itemsize
+    mode = R.resolve_precision(precision, op, arrs[0].dtype, total_bytes,
+                               cfg, n)
+    block = cfg.quant_block_size
     hier = _hier_split(process_set)
-    if hier is not None and not (
+    if hier is not None and (mode != "fp32" or not (
             op is ReduceOp.SUM
             or (op is ReduceOp.AVERAGE
-                and jnp.issubdtype(arrs[0].dtype, jnp.floating))):
+                and jnp.issubdtype(arrs[0].dtype, jnp.floating)))):
         hier = None
     key = _sig(mesh, axis, "grouped_allreduce", op, arrs[0].dtype.name,
-               numels, shapes, hier,
+               numels, shapes, hier, mode, block,
                float(prescale_factor), float(postscale_factor))
     fn = _cache.get_or_build(
         key, lambda: _build_grouped_allreduce(
             mesh, axis, op, numels, shapes,
-            float(prescale_factor), float(postscale_factor), hier=hier))
+            float(prescale_factor), float(postscale_factor), hier=hier,
+            mode=mode, block=block, dtype=arrs[0].dtype))
+    if mode != "fp32":
+        R.account_wire(mode, total_bytes, n, block,
+                       itemsize=arrs[0].dtype.itemsize)
     return list(fn(arrs))
 
 

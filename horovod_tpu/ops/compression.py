@@ -7,6 +7,17 @@ are cast down before the allreduce and restored after, halving wire bytes.
 TPU-native note: the natural 16-bit format on TPU is bfloat16 (same exponent
 range as fp32 — no loss-scale bookkeeping needed), so ``fp16`` here defaults
 to bf16 payloads with an ``np.float16`` option for exact reference parity.
+
+Since the reduction-algebra layer (:mod:`ops.reduction`) landed, every
+compressor also carries a ``wire_mode`` that routes the same intent
+through the engine's fused hot path: ``hvd.allreduce(t, compression=
+Compression.fp16)`` casts *inside* the compiled collective, and the new
+``Compression.int8`` / ``Compression.fp8`` entries select block-scaled
+quantized allreduce.  The host-side ``compress``/``decompress`` pair
+remains for the torch/tf wrapper layers' staged buffers; for the
+quantized entries it is the identity — quantization must happen inside
+the collective (per-rank int8 values cannot be summed by a plain
+allreduce), so those entries only make sense via ``wire_mode`` routing.
 """
 
 from __future__ import annotations
@@ -18,6 +29,10 @@ import jax.numpy as jnp
 
 class Compressor:
     """Interface († ``Compression`` class hierarchy)."""
+
+    #: wire mode the engine applies when this compressor is passed as
+    #: ``compression=`` ("" = engine/config default).
+    wire_mode = ""
 
     @staticmethod
     def compress(tensor: Any) -> tuple[Any, Any]:
@@ -43,6 +58,7 @@ class FP16Compressor(Compressor):
     """Cast float tensors to 16-bit for the collective, restore after."""
 
     wire_dtype = jnp.bfloat16
+    wire_mode = "bf16"
 
     @classmethod
     def compress(cls, tensor):
@@ -60,11 +76,44 @@ class IEEEFP16Compressor(FP16Compressor):
     """Exact reference parity: IEEE float16 wire format."""
 
     wire_dtype = jnp.float16
+    wire_mode = "fp16"
+
+
+class Int8Compressor(NoneCompressor):
+    """Block-scaled int8 quantized wire (EQuARX-style) — engine-side.
+
+    Host-side compress is the identity: per-rank quantized integers with
+    independent scales cannot be summed by a plain allreduce, so the
+    quantize -> reduce-scatter -> dequant-accumulate -> allgather
+    pipeline runs inside the engine's compiled collective
+    (:mod:`ops.reduction`).
+    """
+
+    wire_mode = "int8"
+
+
+class FP8Compressor(NoneCompressor):
+    """Block-scaled fp8-e4m3 quantized wire — engine-side, like int8."""
+
+    wire_mode = "fp8"
+
+
+def routes_engine_side(compression) -> bool:
+    """True when a compressor must ride the engine's wire-mode path
+    instead of host-side compress/decompress — the single routing rule
+    the torch/tf/optax wrapper layers share.  Quantized modes qualify
+    (per-rank int8 values with independent scales cannot be summed by a
+    plain allreduce); cast modes keep their host-side staging."""
+    from .reduction import QUANT_MODES
+    return getattr(compression, "wire_mode", "") in QUANT_MODES
 
 
 class Compression:
-    """Namespace matching ``hvd.Compression.{none,fp16}`` (†)."""
+    """Namespace matching ``hvd.Compression.{none,fp16}`` (†), extended
+    with the engine's quantized wire modes."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     fp16_ieee = IEEEFP16Compressor
+    int8 = Int8Compressor
+    fp8 = FP8Compressor

@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Sequence
 
 from . import collectives as C
+from . import reduction as _R
 from ..obs import REGISTRY as _obs
 from ..utils import logging as hvd_logging
 
@@ -92,6 +93,10 @@ class TensorTableEntry:
     prescale: float = 1.0
     postscale: float = 1.0
     process_set: Any = None
+    # Wire precision mode (ops/reduction.py): resolved at enqueue time so
+    # every rank derives it from the same (op, dtype, size, config) and
+    # fused groups / negotiation signatures agree.  "" = fp32 default.
+    precision: str = ""
     enqueue_time: float = field(default_factory=time.monotonic)
     # Timeline phase currently open for this entry ("" | QUEUE | NEGOTIATE);
     # † timeline.cc tracks the same per-tensor lifecycle state.
@@ -123,6 +128,13 @@ class TensorTableEntry:
             m["ps"] = self.prescale
         if self.postscale != 1.0:
             m["po"] = self.postscale
+        if self.precision and self.precision != "fp32":
+            # The negotiator signature carries the wire mode: a joined
+            # rank must fabricate its zero participation at the SAME
+            # precision or the fused XLA programs diverge across ranks.
+            # fp32 (the implicit default) is omitted so default-mode
+            # metas stay byte-identical with pre-wire-precision peers.
+            m["wp"] = self.precision
         return json.dumps(m, separators=(",", ":"))
 
 
@@ -156,6 +168,10 @@ def _parse_joinable_meta(meta: str) -> Optional[dict]:
         m["s"] = [int(d) for d in m["s"]]
         C.ReduceOp(m["o"])
         if not isinstance(m["d"], str):
+            return None
+        if m.get("wp", "") not in ("",) + _R.MODES:
+            # Unknown wire mode from a version-skewed peer: we could not
+            # build a matching program — skip, don't crash the cycle.
             return None
     except (ValueError, TypeError, KeyError):
         return None
@@ -621,7 +637,7 @@ class CollectiveEngine:
             name=name, verb=m["v"], payload=payload,
             op=C.ReduceOp(m["o"]), root_rank=m.get("r", 0),
             splits=m.get("sp"), prescale=m.get("ps", 1.0),
-            postscale=m.get("po", 1.0))
+            postscale=m.get("po", 1.0), precision=m.get("wp", ""))
 
     @staticmethod
     def _entry_bytes(e: TensorTableEntry) -> int:
@@ -645,8 +661,14 @@ class CollectiveEngine:
         singles: list[list[TensorTableEntry]] = []
         for e in entries:
             if e.verb == "allreduce" and e.op is not C.ReduceOp.ADASUM:
+                # Same wire precision fuses together; mixing modes in one
+                # buffer would force the whole group to the widest wire.
+                # "" (entries built without API resolution, e.g. join
+                # zero-participation for default-mode tensors) IS fp32 —
+                # normalized here so both fuse identically on all ranks.
                 key = ("allreduce", e.op, str(e.payload.dtype),
-                       id(e.process_set), e.prescale, e.postscale)
+                       id(e.process_set), e.prescale, e.postscale,
+                       e.precision or "fp32")
                 if key not in groups:
                     groups[key] = []
                     order.append(key)
@@ -723,10 +745,12 @@ class CollectiveEngine:
                 return [C.allreduce(e0.payload, e0.op,
                                     prescale_factor=e0.prescale,
                                     postscale_factor=e0.postscale,
+                                    precision=e0.precision or "fp32",
                                     process_set=e0.process_set)]
             return C.grouped_allreduce(
                 [e.payload for e in group], e0.op,
                 prescale_factor=e0.prescale, postscale_factor=e0.postscale,
+                precision=e0.precision or "fp32",
                 process_set=e0.process_set)
         assert len(group) == 1
         if e0.verb == "allgather":

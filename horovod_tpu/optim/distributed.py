@@ -38,7 +38,7 @@ import optax
 from jax import lax
 
 from ..ops import collectives as C
-from ..ops.compression import Compression, Compressor
+from ..ops.compression import Compression, Compressor, routes_engine_side
 
 
 def _in_axis_context(axis_name: str) -> bool:
@@ -52,7 +52,30 @@ def _in_axis_context(axis_name: str) -> bool:
 
 def _reduce_in_context(g, axis_name: str, op: C.ReduceOp,
                        compression: type[Compressor]):
-    """Average/sum/adasum one gradient leaf across the mapped axis."""
+    """Average/sum/adasum one gradient leaf across the mapped axis.
+
+    Quantized compressors (``Compression.int8`` / ``fp8``) lower to the
+    reduction-algebra's in-context form: shared block scales via
+    ``pmax``, then one ``psum`` of the narrow accumulator — 2B/elem on
+    the wire instead of 4 (see :mod:`ops.reduction`).  Adasum never
+    quantizes (dot-product projections amplify the error).
+    """
+    g_arr = jnp.asarray(g)
+    if routes_engine_side(compression) \
+            and op in (C.ReduceOp.AVERAGE, C.ReduceOp.SUM) \
+            and jnp.issubdtype(g_arr.dtype, jnp.floating):
+        from ..ops.reduction import in_context_allreduce
+        from ..context import global_state
+        from .. import config as config_mod
+        state = global_state()
+        # Trace-time constants; dataclass defaults before init().
+        cfg = state.config if state.initialized else config_mod.Config()
+        if int(g_arr.size) * g_arr.dtype.itemsize >= cfg.quant_min_bytes:
+            return in_context_allreduce(
+                g_arr, axis_name, compression.wire_mode,
+                average=op is C.ReduceOp.AVERAGE,
+                block=cfg.quant_block_size)
+        # Sub-floor leaves ride fp32, same as the engine path's resolver.
     wire, ctx = compression.compress(g)
     if op is C.ReduceOp.AVERAGE:
         red = lax.pmean(wire, axis_name)
@@ -173,12 +196,20 @@ def distributed_gradients(per_rank_grads: Any,
     """
     import horovod_tpu as hvd
     leaves, treedef = jax.tree.flatten(per_rank_grads)
+    # Quantized compressors route as wire modes: the engine quantizes
+    # inside the fused collective (host-side int8 values with per-rank
+    # scales could not be summed by a plain allreduce).
+    kw = {"compression": compression} if routes_engine_side(compression) \
+        else {}
     compressed, ctxs = [], []
     for leaf in leaves:
-        wire, ctx = compression.compress(jnp.asarray(leaf))
+        if kw:
+            wire, ctx = jnp.asarray(leaf), None
+        else:
+            wire, ctx = compression.compress(jnp.asarray(leaf))
         compressed.append(wire)
         ctxs.append(ctx)
-    handles = [hvd.allreduce_async(leaf, op, process_set=process_set)
+    handles = [hvd.allreduce_async(leaf, op, process_set=process_set, **kw)
                for leaf in compressed]
     reduced = [compression.decompress(h.wait(), ctx)
                for h, ctx in zip(handles, ctxs)]

@@ -251,6 +251,11 @@ def _grouped_allreduce_grads_eager(flat_grads, op: ReduceOp, compression):
     handles: list = []
     ctxs: list = []
     idx: list[int] = []
+    # Quantized compressors route as engine wire modes; cast compressors
+    # keep the host-side compress (see ops/compression.py).
+    from horovod_tpu.ops.compression import routes_engine_side
+    kw = ({"compression": compression} if routes_engine_side(compression)
+          else {})
     for i, g in enumerate(flat_grads):
         if g is None:
             continue
@@ -260,9 +265,12 @@ def _grouped_allreduce_grads_eager(flat_grads, op: ReduceOp, compression):
             dense = np.zeros(g.dense_shape.numpy(), arr.dtype)
             np.add.at(dense, g.indices.numpy(), arr)
             arr = dense
-        wire, ctx = compression.compress(jnp.asarray(arr))
+        if kw:
+            wire, ctx = jnp.asarray(arr), None
+        else:
+            wire, ctx = compression.compress(jnp.asarray(arr))
         handles.append(_hvd.allreduce_async(
-            _to_per_rank(np.asarray(wire)), op, name=f"tf.grad.{i}"))
+            _to_per_rank(np.asarray(wire)), op, name=f"tf.grad.{i}", **kw))
         ctxs.append(ctx)
         idx.append(i)
     out = list(flat_grads)

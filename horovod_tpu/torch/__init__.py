@@ -332,6 +332,12 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         from horovod_tpu.ops.collectives import replicate_local
         flat = (entries[0][1].ravel() if len(entries) == 1 else
                 np.concatenate([a.ravel() for _, a in entries]))
+        # Quantized compressors route as engine wire modes (quantization
+        # must live inside the collective); cast compressors keep the
+        # host-side compress so the staged device buffer is already 16-bit.
+        from horovod_tpu.ops.compression import routes_engine_side
+        kw = ({"compression": self._compression}
+              if routes_engine_side(self._compression) else {})
         wire, ctx = self._compression.compress(jnp.asarray(flat))
         seq = self._bucket_seq
         self._bucket_seq += 1
@@ -344,7 +350,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             .encode()).hexdigest()[:10]
         handle = _hvd.allreduce_async(
             replicate_local(np.asarray(wire)), self.op,
-            name=f"gradbucket.{key}.{seq}.{fp}")
+            name=f"gradbucket.{key}.{seq}.{fp}", **kw)
         self._inflight.append((handle, entries, ctx))
 
     def synchronize(self) -> None:

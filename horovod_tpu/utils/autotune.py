@@ -1,4 +1,4 @@
-"""Online autotuning of fusion-threshold and cycle-time.
+"""Online autotuning of fusion-threshold, cycle-time and wire precision.
 
 â€  ``horovod/common/parameter_manager.cc`` + ``optim/bayesian_optimization.cc``:
 the reference tunes (fusion threshold, cycle time) online with Bayesian
@@ -9,7 +9,29 @@ This implementation keeps the same control loop (warmup â†’ propose â†’ score â†
 commit best) with a Gaussian-process surrogate implemented in numpy (RBF
 kernel + expected improvement over a candidate grid).  Eigen/LBFGS hyperparam
 refits are replaced by a small fixed-length-scale kernel â€” adequate for a
-2-D, low-noise search space.
+low-noise search space.
+
+Knob space, v2: 3-D.  Beyond the reference's (threshold, cycle-time), the
+third dimension is the engine's **wire precision** (``ops/reduction.py``):
+fp32, bf16, or block-scaled int8.  The score is *effective* bytes/s â€”
+logical fp32 payload bytes per cycle second â€” so a mode that moves fewer
+wire bytes in less time scores higher, and the GP picks the precision the
+interconnect actually rewards (on TPU, quantized; on the CPU rig, whose
+collectives are byte-width-insensitive, it correctly learns fp32).
+
+Multi-process jobs pin the precision dimension to the configured
+default: each rank scores from rank-local timings, and a per-rank
+precision commit would resolve the same tensor to different wire modes
+on different ranks â€” divergent fused programs, a hang.  Single-
+controller mode (one process, all devices) tunes all three dimensions.
+
+Tensor-size bucketing: the precision knob governs the *quantizable
+bucket* â€” tensors at or above ``quant_min_bytes``.  Tensors below the
+floor always ride fp32 (``reduction.resolve_precision``): the per-block
+scale traffic and encode pass are not worth it there, so the bucket
+boundary is a config knob rather than a fourth GP dimension.  The
+committed precision lands in ``config.wire_precision``, which entries
+resolve against at enqueue time.
 """
 
 from __future__ import annotations
@@ -23,14 +45,20 @@ import numpy as np
 from ..obs import REGISTRY as _obs
 
 # Candidate grid (log2 bytes for threshold, ms for cycle time), spanning the
-# same range the reference explores.
+# same range the reference explores, crossed with the wire modes worth
+# searching (fp8's e4m3 error is opt-in only, never auto-committed).
 _THRESHOLDS = [1 << p for p in range(20, 28)]         # 1 MB .. 128 MB
 _CYCLE_TIMES = [0.5, 1.0, 2.5, 5.0, 10.0, 20.0]        # ms
+_WIRE_MODES = ["fp32", "bf16", "int8"]
+# GP-space spacing between adjacent modes; comparable to one grid step in
+# the log2-threshold dimension so no dimension dominates the RBF distance.
+_MODE_SCALE = 2.0
 
 _m_trials = _obs.counter(
     "hvd_autotune_trials_total", "knob configurations scored by the tuner")
 _m_score = _obs.gauge(
-    "hvd_autotune_score_bytes_per_s", "latest trial's throughput score")
+    "hvd_autotune_score_bytes_per_s",
+    "latest trial's effective (logical bytes) throughput score")
 _m_threshold = _obs.gauge(
     "hvd_autotune_fusion_threshold_bytes", "fusion threshold in effect")
 _m_cycle_ms = _obs.gauge(
@@ -38,7 +66,7 @@ _m_cycle_ms = _obs.gauge(
 
 
 class _GP:
-    """Minimal RBF-kernel GP regressor for the 2-D knob space."""
+    """Minimal RBF-kernel GP regressor for the 3-D knob space."""
 
     def __init__(self, length_scale: float = 1.0, noise: float = 1e-3) -> None:
         self.ls = length_scale
@@ -76,26 +104,60 @@ def _expected_improvement(mu: np.ndarray, var: np.ndarray, best: float
 class Autotuner:
     """Propose/score loop attached to the engine's cycle callback."""
 
+    def _norm_point(self, threshold: int, cycle_ms: float, mode: str
+                    ) -> tuple[float, float, float]:
+        """Raw knobs -> GP coordinates (mode index is instance-local)."""
+        return (math.log2(threshold), math.log2(cycle_ms),
+                self._modes.index(mode) * _MODE_SCALE)
+
     def __init__(self, state) -> None:
         self._state = state
         cfg = state.config
         self._warmup_left = cfg.autotune_warmup_samples
         self._steps_per_sample = cfg.autotune_steps_per_sample
         self._log_path = cfg.autotune_log
-        # Normalized candidate grid.
-        self._grid = np.array([
-            (math.log2(t), math.log2(c))
-            for t in _THRESHOLDS for c in _CYCLE_TIMES])
-        self._grid_raw = [(t, c) for t in _THRESHOLDS for c in _CYCLE_TIMES]
-        self._samples_X: list[tuple[float, float]] = []
+        # Mode dimension, per instance:
+        # - An explicitly configured off-grid mode (fp16/fp8) joins the
+        #   search instead of being silently reverted â€” the user opted
+        #   into its error model, so the tuner may keep proposing it.
+        # - Multi-process engines PIN the mode to the configured default:
+        #   each rank tunes from rank-local scores, and a per-rank
+        #   wire_precision commit would make the same tensor resolve to
+        #   different modes on different ranks at enqueue â€” divergent
+        #   fused programs across processes, i.e. a hang.  (threshold/
+        #   cycle knobs only pace the local cycle thread; group
+        #   composition still agrees via negotiation order, and bucket
+        #   construction latches its own cap â€” see torch optimizer.)
+        engine = getattr(state, "engine", None)
+        distributed = bool(engine is not None and engine.distributed)
+        default = cfg.wire_precision or "fp32"
+        if distributed:
+            self._modes = [default]
+        else:
+            self._modes = _WIRE_MODES + (
+                [default] if default not in _WIRE_MODES else [])
+        self._grid_raw = [(t, c, m) for t in _THRESHOLDS
+                          for c in _CYCLE_TIMES for m in self._modes]
+        self._grid = np.array([self._norm_point(*p) for p in self._grid_raw])
+        # Normalized GP inputs AND the exact raw grid knobs of each
+        # sample.  Committing from the raw record (not a ``2 ** log2``
+        # round-trip of the normalized floats) keeps the committed
+        # cycle-time exactly on the candidate grid â€” the round-trip
+        # drifted (e.g. 2.5 ms -> 2.4999999999999996) so the converged
+        # knobs were values no candidate ever proposed.
+        self._samples_X: list[tuple[float, float, float]] = []
+        self._samples_raw: list[tuple[int, float, str]] = []
         self._samples_y: list[float] = []
-        self._current = (cfg.fusion_threshold, cfg.cycle_time_ms)
+        self._current = (cfg.fusion_threshold, cfg.cycle_time_ms, default)
         self._acc_bytes = 0
         self._acc_time = 0.0
         self._acc_cycles = 0
         self._done = False
 
     def record_cycle(self, payload_bytes: int, cycle_seconds: float) -> None:
+        """Score one engine cycle.  ``payload_bytes`` is the LOGICAL
+        payload (entry bytes, not wire bytes) so the score is effective
+        throughput and precision modes compete on delivered gradients."""
         if self._done or payload_bytes == 0:
             return
         self._acc_bytes += payload_bytes
@@ -109,8 +171,9 @@ class Autotuner:
             self._warmup_left -= 1
             self._log(f"warmup score={score:.3e}")
             return
-        t, c = self._current
-        self._samples_X.append((math.log2(t), math.log2(c)))
+        t, c, m = self._current
+        self._samples_X.append(self._norm_point(t, c, m))
+        self._samples_raw.append((t, c, m))
         self._samples_y.append(score)
         _m_trials.inc()
         _m_score.set(score)
@@ -125,31 +188,35 @@ class Autotuner:
         mu, var = gp.predict(self._grid)
         ei = _expected_improvement(mu, var, y_norm.max())
         idx = int(np.argmax(ei))
-        threshold, cycle = self._grid_raw[idx]
-        self._apply(threshold, cycle)
+        threshold, cycle, mode = self._grid_raw[idx]
+        self._apply(threshold, cycle, mode)
         best = int(np.argmax(y))
         self._log(
             f"sample #{len(y)} score={y[-1]:.3e} -> next "
-            f"threshold={threshold} cycle_ms={cycle} "
+            f"threshold={threshold} cycle_ms={cycle} wire={mode} "
             f"(best so far {self._raw(best)} @ {y[best]:.3e})")
         # Convergence: stop after exploring enough with no improvement,
         # committing the best-seen knobs (â€  ParameterManager stops tuning).
         if len(y) >= 12 and best < len(y) - 6:
-            bt, bc = self._raw(best)
-            self._apply(bt, bc)
+            bt, bc, bm = self._raw(best)
+            self._apply(bt, bc, bm)
             self._done = True
-            self._log(f"converged: threshold={bt} cycle_ms={bc}")
+            self._log(f"converged: threshold={bt} cycle_ms={bc} wire={bm}")
 
-    def _raw(self, i: int) -> tuple[int, float]:
-        t, c = self._samples_X[i]
-        return int(round(2 ** t)), float(2 ** c)
+    def _raw(self, i: int) -> tuple[int, float, str]:
+        """Exact grid knobs of sample *i* â€” from the raw record, never a
+        ``2 ** log2(x)`` round-trip of the normalized GP coordinates."""
+        return self._samples_raw[i]
 
-    def _apply(self, threshold: int, cycle_ms: float) -> None:
-        self._current = (threshold, cycle_ms)
+    def _apply(self, threshold: int, cycle_ms: float, mode: str) -> None:
+        self._current = (threshold, cycle_ms, mode)
         self._state.config.fusion_threshold = threshold
         self._state.config.cycle_time_ms = cycle_ms
+        self._state.config.wire_precision = mode
         _m_threshold.set(threshold)
         _m_cycle_ms.set(cycle_ms)
+        from ..ops import reduction as _R
+        _R.publish_mode_gauge(mode)
 
     def _log(self, msg: str) -> None:
         if not self._log_path:

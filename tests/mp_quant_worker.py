@@ -1,0 +1,68 @@
+"""Quantized-allreduce parity over the real negotiated transport.
+
+Run under ``hvdrun -np 2`` (the ci.yaml quantized-parity job) or ``-np 4``:
+every rank allreduces the same random gradients at fp32 and at each wire
+mode through the async engine (fusion + coordinator-ordered dispatch), and
+asserts the quantized results agree with exact numpy within the documented
+shared-scale error bound (tests/test_reduction.py derives it).  Also
+exercises the negotiation meta's precision field: all ranks must build the
+same quantized program or the fused dispatch diverges and the job hangs —
+completion IS the assertion for that.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=1"
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main() -> int:
+    hvd.init()
+    me, n = hvd.rank(), hvd.size()
+    hvd.global_state().config.quant_min_bytes = 0
+    numel = 4096
+    # Every rank derives every rank's gradient (seeded) so exact numpy
+    # references need no extra collective.
+    grads = [np.random.RandomState(100 + r).randn(numel).astype(np.float32)
+             for r in range(n)]
+    exact_avg = np.stack(grads).mean(0)
+    gmax = np.abs(np.stack(grads)).max()
+
+    for mode, tol_div in (("bf16", None), ("int8", 254.0), ("fp8", 16.0)):
+        hs = [hvd.allreduce_async(
+            hvd.from_local(grads[me][None, i * 1024:(i + 1) * 1024]),
+            hvd.Average, name=f"q.{mode}.{i}", compression=mode)
+            for i in range(4)]
+        got = np.concatenate(
+            [hvd.to_numpy(hvd.synchronize(h)) for h in hs])
+        if tol_div is None:
+            atol = (n + 1) * gmax * 2.0 ** -7
+        else:
+            atol = 1.5 * (n + 1) * gmax / tol_div
+        err = np.abs(got - exact_avg).max()
+        assert err <= atol, (mode, err, atol)
+        print(f"rank {me}: {mode} parity err={err:.2e} <= {atol:.2e}",
+              flush=True)
+
+    # Mixed modes in one cycle: int8 and fp32 entries must split into
+    # separate fused groups consistently on every rank (completion proves
+    # the cross-rank group composition matched).
+    ha = hvd.allreduce_async(hvd.from_local(grads[me][None, :1024]),
+                             hvd.Average, name="q.mix.a", compression="int8")
+    hb = hvd.allreduce_async(hvd.from_local(grads[me][None, 1024:2048]),
+                             hvd.Average, name="q.mix.b")
+    hvd.synchronize(ha)
+    hvd.synchronize(hb)
+    hvd.barrier()
+    print(f"rank {me}: QUANT-OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -162,7 +162,7 @@ def test_autotuner_proposes_and_converges(tmp_path):
     for i in range(200):
         if at._done:
             break
-        t, c = at._current
+        t, c, m = at._current
         score_bias = 1.0 + (np.log2(t) - 20) * 0.1
         at.record_cycle(int(1e6 * score_bias), 0.001)
     log = (tmp_path / "at.log").read_text()
@@ -170,6 +170,45 @@ def test_autotuner_proposes_and_converges(tmp_path):
     # Knobs were mutated by the proposals.
     assert (st.config.fusion_threshold, st.config.cycle_time_ms) != (
         64 * 1024 * 1024, 5.0) or at._done
+
+
+def test_autotuner_commits_exact_grid_values(tmp_path):
+    """Regression: the converged knobs must be EXACT candidate-grid
+    values.  The old ``_raw`` reconstructed them as ``2 ** log2(x)`` from
+    the normalized GP samples, which drifted the committed cycle time off
+    the grid (2.5 -> 2.4999999999999996)."""
+    from horovod_tpu.utils.autotune import (
+        Autotuner, _CYCLE_TIMES, _THRESHOLDS, _WIRE_MODES)
+
+    class FakeState:
+        pass
+
+    from horovod_tpu import config as config_mod
+    st = FakeState()
+    st.config = config_mod.Config(
+        autotune=True, autotune_warmup_samples=0,
+        autotune_steps_per_sample=1, cycle_time_ms=2.5)
+    at = Autotuner(st)
+    rng = np.random.RandomState(0)
+    for i in range(400):
+        if at._done:
+            break
+        # Flat-ish noisy scores: convergence picks SOME sampled config.
+        at.record_cycle(int(1e6 + rng.randint(0, 1000)), 0.001)
+    assert at._done, "tuner never converged"
+    t, c, m = at._current
+    assert t in _THRESHOLDS or t == st.config.fusion_threshold
+    assert st.config.fusion_threshold == t
+    # The drift bug showed up in the float knob: exact membership now.
+    assert c in _CYCLE_TIMES or c == 2.5
+    assert st.config.cycle_time_ms == c
+    assert m in _WIRE_MODES
+    assert st.config.wire_precision == m
+    # Every recorded sample keeps exact raw knobs alongside the GP coords.
+    for (rt, rc, rm), (xt, xc, xm) in zip(at._samples_raw, at._samples_X):
+        assert rt in _THRESHOLDS or rt == 64 * 1024 * 1024
+        assert rc in _CYCLE_TIMES or rc == 2.5
+        assert 2.0 ** xt == pytest.approx(rt)
 
 
 @pytest.mark.integration

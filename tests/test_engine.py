@@ -98,6 +98,55 @@ def test_fusion_respects_threshold():
         state.config.fusion_threshold = old
 
 
+def test_fusion_splits_mixed_wire_precision():
+    """Same-precision entries fuse; mixed modes land in separate groups
+    (one compiled program per wire mode), and the negotiation meta
+    carries the precision field so joined ranks rebuild entries at the
+    same mode."""
+    import json
+    from horovod_tpu.ops.engine import TensorTableEntry
+    eng = hvd.global_state().engine
+    old_floor = hvd.global_state().config.quant_min_bytes
+    hvd.global_state().config.quant_min_bytes = 0
+    try:
+        x = hvd.per_rank([np.ones((64,), np.float32)] * N)
+        entries = [
+            TensorTableEntry(name=f"t.mixp.{i}", verb="allreduce",
+                             payload=x, op=hvd.Sum, precision=p)
+            for i, p in enumerate(["int8", "int8", "fp32", "bf16"])]
+        groups = eng._fuse(entries)
+        keyed = sorted(tuple(e.precision for e in g) for g in groups)
+        assert keyed == [("bf16",), ("fp32",), ("int8", "int8")]
+        meta = json.loads(entries[0].meta())
+        assert meta["wp"] == "int8"
+        assert "wp" not in json.loads(entries[2].meta())  # "" omitted...
+    finally:
+        hvd.global_state().config.quant_min_bytes = old_floor
+
+
+def test_engine_quantized_vs_fp32_parity():
+    """Quantized allreduce through the full async engine path must agree
+    with the fp32 result within the documented tolerance (1.5x the
+    shared-scale error bound; see tests/test_reduction.py)."""
+    old_floor = hvd.global_state().config.quant_min_bytes
+    hvd.global_state().config.quant_min_bytes = 0
+    try:
+        rng = np.random.RandomState(42)
+        parts = [rng.randn(1000).astype(np.float32) for _ in range(N)]
+        x = hvd.per_rank(parts)
+        h32 = hvd.allreduce_async(x, hvd.Average, name="t.par.f32")
+        h8 = hvd.allreduce_async(x, hvd.Average, name="t.par.i8",
+                                 compression="int8")
+        ref = hvd.to_numpy(hvd.synchronize(h32))
+        got = hvd.to_numpy(hvd.synchronize(h8))
+        gmax = np.abs(np.stack(parts)).max()
+        np.testing.assert_allclose(got, ref,
+                                   atol=1.5 * (N + 1) * gmax / 254.0)
+        assert np.abs(got - ref).max() > 0  # int8 wire is lossy: it ran
+    finally:
+        hvd.global_state().config.quant_min_bytes = old_floor
+
+
 def test_process_set_allreduce():
     ps = hvd.add_process_set([0, 2, 4, 6])
     parts = [np.full((3,), float(r), np.float32) for r in (0, 2, 4, 6)]

@@ -128,6 +128,21 @@ def test_hvdrun_no_command():
 
 
 @pytest.mark.integration
+@pytest.mark.parametrize("np_", [2, 4])
+def test_hvdrun_quantized_allreduce_parity(np_):
+    """Block-scaled int8/fp8/bf16 wire modes over real negotiated
+    transport: parity within the documented tolerance at np=2 (the
+    ci.yaml quantized-parity job) and np=4, plus mixed-mode fusion-group
+    consistency across processes (divergent groups would hang, so
+    completion is the assertion)."""
+    res = _hvdrun(np_, [os.path.join(REPO, "tests", "mp_quant_worker.py")],
+                  timeout=120 + 30 * np_)
+    assert res.returncode == 0, res.stdout + res.stderr
+    for r in range(np_):
+        assert f"rank {r}: QUANT-OK" in res.stdout, res.stdout
+
+
+@pytest.mark.integration
 def test_hvdrun_join_uneven_inputs():
     """† test_horovod_join: rank 0 runs 3 steps, rank 1 runs 5; the job
     completes (no deadlock) and surviving-step allreduces are correct."""
