@@ -27,6 +27,23 @@ Read both columns together: on TPU wire time dominates so
 rig's collectives are shared-memory and byte-width-insensitive while its
 8x-oversubscribed cores inflate the quantize arithmetic, so wall-clock
 there does NOT improve — see docs/performance.md "Wire precision".
+
+Schedule (``--schedule monolithic,rs_ag:2,rs_ag:4,...``): sweeps the
+collective schedule (ops/sched) and reports per row
+
+- ``dispatch_GBs`` — measured wall-clock (monolithic psum vs the chunked
+  reduce-scatter/allgather pipeline);
+- ``overlap_window`` — the analytic fraction of communication the
+  schedule *exposes* for overlap, ``(k-1)/k`` at k chunks (chunk c's
+  comm can hide under the other chunks' compute);
+- ``overlap_fraction`` — the executor's measured in-flight overlap
+  gauge for the run (host dispatch windows).
+
+Same caveat pattern as wire precision: the CPU rig serializes device
+work, so decomposed wall-clock there is dispatch-overhead-bound and does
+NOT improve; ``overlap_window`` is the number that transfers to a TPU
+whose async collectives fill it.  ``--out`` writes the schedule sweep as
+a BENCH_rXX.json-style record.
 """
 
 from __future__ import annotations
@@ -50,7 +67,8 @@ def jax_device_get_first(x):
 
 
 def allreduce_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
-                    dtype="float32", wire_precision: str = "fp32") -> dict:
+                    dtype="float32", wire_precision: str = "fp32",
+                    schedule: str = "monolithic") -> dict:
     """One allreduce bandwidth point on the current global mesh."""
     import jax
     import jax.numpy as jnp
@@ -63,20 +81,30 @@ def allreduce_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
     x = hvd.per_rank_from_fn(
         lambda r: np.full((numel,), float(r + 1), dtype))
     from horovod_tpu.ops import collectives as C
+    from horovod_tpu.ops import sched as S
     cfg = hvd.global_state().config
     # Report what actually runs: the resolver may downgrade (size floor,
     # single-rank mesh, ...) — a row must never claim quantized savings
-    # for an allreduce that executed at fp32.
+    # for an allreduce that executed at fp32, nor overlap for one that
+    # ran monolithic.
     resolved = R.resolve_precision(wire_precision, hvd.Sum, np.dtype(dtype),
                                    nbytes, cfg, n)
-    out = C.allreduce(x, hvd.Sum, precision=wire_precision)
+    resolved_sched = S.resolve_schedule(schedule, "allreduce", hvd.Sum,
+                                        np.dtype(dtype), nbytes, cfg, n,
+                                        resolved)
+
+    def one():
+        return C.allreduce(x, hvd.Sum, precision=wire_precision,
+                           schedule=schedule)
+
+    out = one()
     _fence(out)
     for _ in range(warmup):
-        out = C.allreduce(x, hvd.Sum, precision=wire_precision)
+        out = one()
     _fence(out)
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = C.allreduce(x, hvd.Sum, precision=wire_precision)
+        out = one()
     _fence(out)
     dt = (time.perf_counter() - t0) / iters
     payload = numel * itemsize
@@ -86,6 +114,18 @@ def allreduce_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
            "wire_precision": resolved}
     if resolved != wire_precision:
         row["requested_precision"] = wire_precision
+    if schedule != "monolithic":
+        row["schedule"] = resolved_sched or "monolithic"
+        if resolved_sched:
+            from horovod_tpu.ops.sched import executor as SE
+            k = len(S.chunk_layout(numel, n, S.parse_descriptor(
+                resolved_sched), resolved, cfg.quant_block_size))
+            # Analytic overlap window: with k chunks dispatched
+            # interleaved, (k-1)/k of the communication can hide under
+            # other chunks' compute on an async-collective backend.
+            row["chunks"] = k
+            row["overlap_window"] = round((k - 1) / k, 3)
+            row["overlap_fraction"] = round(SE._m_overlap.value, 6)
     if resolved != "fp32":
         block = cfg.quant_block_size
         wire = R.ring_wire_bytes(resolved, payload, n, block, itemsize)
@@ -104,11 +144,12 @@ def allreduce_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
     return row
 
 
-def sweep(sizes=None, modes=("fp32",), **kw) -> list[dict]:
+def sweep(sizes=None, modes=("fp32",), schedules=("monolithic",),
+          **kw) -> list[dict]:
     if sizes is None:
         sizes = [1 << p for p in range(12, 27, 2)]   # 4 KB .. 64 MB
-    return [allreduce_busbw(s, wire_precision=m, **kw)
-            for m in modes for s in sizes]
+    return [allreduce_busbw(s, wire_precision=m, schedule=sc, **kw)
+            for sc in schedules for m in modes for s in sizes]
 
 
 def main() -> None:
@@ -123,6 +164,14 @@ def main() -> None:
                     "(fp32,bf16,fp16,int8,fp8); each mode reports "
                     "dispatch_GBs (measured) and wire_reduction (analytic "
                     "interconnect saving vs fp32)")
+    ap.add_argument("--schedule", default="monolithic", metavar="SCHEDS",
+                    help="comma-separated schedules to sweep (monolithic,"
+                    "rs_ag:2,rs_ag:4,...); decomposed rows report "
+                    "dispatch_GBs (measured), overlap_window (analytic "
+                    "(k-1)/k) and overlap_fraction (executor gauge)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write the schedule-sweep summary as a JSON "
+                    "record (BENCH_rXX.json shape)")
     args = ap.parse_args()
     if args.cpu_devices:
         from horovod_tpu.utils.cpurig import force_cpu_platform
@@ -133,7 +182,8 @@ def main() -> None:
     # mode at every size, not to second-guess the resolver.
     hvd.global_state().config.quant_min_bytes = 0
     modes = [m.strip() for m in args.wire_precision.split(",") if m.strip()]
-    rows = sweep(modes=modes)
+    schedules = [s.strip() for s in args.schedule.split(",") if s.strip()]
+    rows = sweep(modes=modes, schedules=schedules)
     for r in rows:
         print(json.dumps(r))
     key = "busbw_GBs" if "busbw_GBs" in rows[0] else "dispatch_GBs"
@@ -165,6 +215,47 @@ def main() -> None:
                 "wire_reduction": big[0].get("wire_reduction"),
                 "ranks": big[0]["ranks"],
             }))
+    summary = []
+    if len(schedules) > 1 and "monolithic" in schedules:
+        # Schedule comparison at >= 4 MB: measured wall-clock ratio of
+        # each decomposed variant vs monolithic AT THE SAME WIRE MODE
+        # (mixing modes would divide e.g. fp32 decomposed by int8
+        # monolithic), with the analytic overlap window and the
+        # executor's measured in-flight fraction.
+        by_sched: dict = {}
+        base: dict = {}
+        for r in rows:
+            mkey = (r["wire_precision"], r["bytes"])
+            sc = r.get("schedule", "monolithic")
+            if sc == "monolithic":
+                base[mkey] = r
+            else:
+                by_sched.setdefault(sc, []).append(r)
+        for sc, sc_rows in sorted(by_sched.items()):
+            big = [r for r in sc_rows
+                   if r["bytes"] >= (1 << 22)
+                   and (r["wire_precision"], r["bytes"]) in base]
+            if not big:
+                continue
+            ratios = [
+                r["dispatch_GBs"]
+                / base[(r["wire_precision"], r["bytes"])]["dispatch_GBs"]
+                for r in big]
+            rec = {
+                "metric": f"allreduce_{sc}_vs_monolithic_at_4MB_plus",
+                "measured_dispatch_ratio": round(float(np.mean(ratios)), 3),
+                "overlap_window": big[0].get("overlap_window"),
+                "overlap_fraction": big[0].get("overlap_fraction"),
+                "ranks": big[0]["ranks"],
+            }
+            summary.append(rec)
+            print(json.dumps(rec))
+    if args.out:
+        # Always honored — a sweep without a monolithic baseline still
+        # writes its rows (summary is empty then, not silently dropped).
+        with open(args.out, "w") as fh:
+            json.dump({"schedule_sweep": summary, "rows": rows}, fh,
+                      indent=1)
 
 
 if __name__ == "__main__":

@@ -132,6 +132,18 @@ def _resolve_entry_precision(compression, payload, op, process_set) -> str:
                                  mesh.shape[axis])
 
 
+def _resolve_entry_schedule(payload, op, process_set, mode: str) -> str:
+    """Collective schedule for an engine entry, resolved at enqueue time
+    under the same determinism contract as ``_resolve_entry_precision``
+    (the descriptor rides the negotiation meta's ``sc`` field, so every
+    rank — joined ranks included — must derive the same one)."""
+    state = global_state()
+    if not state.initialized:
+        return ""
+    mesh, axis = _C._mesh_axis(process_set)
+    return _C._resolve_schedule("", op, payload, mesh.shape[axis], mode)
+
+
 def allreduce(x: Any, op: ReduceOp = Average, *,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
               compression=None, process_set=None) -> Any:
@@ -144,12 +156,14 @@ def allreduce(x: Any, op: ReduceOp = Average, *,
     """
     payload = _C.as_per_rank(x, process_set)
     mode = _resolve_entry_precision(compression, payload, op, process_set)
+    sched = _resolve_entry_schedule(payload, op, process_set, mode)
     return _sync_via_engine_or_direct(
         lambda: _C.allreduce(payload, op, prescale_factor=prescale_factor,
                              postscale_factor=postscale_factor,
-                             precision=mode, process_set=process_set),
+                             precision=mode, schedule=sched or "monolithic",
+                             process_set=process_set),
         "allreduce", payload, op=op, prescale=prescale_factor,
-        postscale=postscale_factor, precision=mode,
+        postscale=postscale_factor, precision=mode, schedule=sched,
         process_set=process_set)
 
 
@@ -373,12 +387,13 @@ def allreduce_async(x: Any, op: ReduceOp = Average, *,
     buffer (see :mod:`horovod_tpu.ops.reduction`).
     """
     payload = _C.as_per_rank(x, process_set)
+    mode = _resolve_entry_precision(compression, payload, op, process_set)
     entry = TensorTableEntry(
         name=_auto_name("allreduce", name), verb="allreduce",
         payload=payload, op=op,
         prescale=prescale_factor, postscale=postscale_factor,
-        precision=_resolve_entry_precision(compression, payload, op,
-                                           process_set),
+        precision=mode,
+        schedule=_resolve_entry_schedule(payload, op, process_set, mode),
         process_set=process_set)
     return _engine().enqueue(entry)
 
@@ -427,12 +442,15 @@ def grouped_allreduce_async(xs: Sequence[Any], op: ReduceOp = Average, *,
     eng = _engine()
     for i, x in enumerate(xs):
         payload = _C.as_per_rank(x, process_set)
+        mode = _resolve_entry_precision(compression, payload, op,
+                                        process_set)
         entry = TensorTableEntry(
             name=f"{base}.{i}", verb="allreduce",
             payload=payload, op=op,
             prescale=prescale_factor, postscale=postscale_factor,
-            precision=_resolve_entry_precision(compression, payload, op,
-                                               process_set),
+            precision=mode,
+            schedule=_resolve_entry_schedule(payload, op, process_set,
+                                             mode),
             process_set=process_set)
         handles.append(eng.enqueue(entry))
     return handles
@@ -794,6 +812,11 @@ def __getattr__(name: str):
     if name == "elastic":
         import importlib
         return importlib.import_module("horovod_tpu.elastic")
+    if name == "sched":
+        # ops/sched: the collective schedule IR (hvd.sched.overlap_allreduce
+        # / matmul_reducescatter are the in-jit entry points).
+        import importlib
+        return importlib.import_module("horovod_tpu.ops.sched")
     if name == "run_func":
         # † ``horovod.run`` — programmatic function launcher.
         from .runner.api import run_func

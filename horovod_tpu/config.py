@@ -42,6 +42,14 @@ def _parse_wire_precision(v: str) -> str:
     return lv
 
 
+def _parse_sched_mode(v: str) -> str:
+    lv = v.strip().lower()
+    if lv not in ("monolithic", "decomposed"):
+        raise ValueError(
+            f"sched mode must be 'monolithic' or 'decomposed', got {v!r}")
+    return lv
+
+
 def _parse_bool(v: str) -> bool:
     lv = v.strip().lower()
     if lv in _TRUE:
@@ -80,6 +88,16 @@ class Config:
     # Payloads below this many bytes (per rank) never quantize — the
     # scale traffic and encode pass outweigh the wire saving.
     quant_min_bytes: int = 65536
+
+    # --- collective schedule (ops/sched; GC3-style decomposition) ---
+    # Engine allreduce schedule: "monolithic" (one psum, the default) or
+    # "decomposed" (chunked reduce-scatter -> allgather, later chunks'
+    # communication overlapped with earlier chunks' compute).  Composes
+    # with wire_precision; results are bit-exact either way.
+    sched_mode: str = "monolithic"
+    # Chunk count for the decomposed schedule (payloads too small to cut
+    # into >= 2 chunks fall back to monolithic per resolve_schedule).
+    sched_chunks: int = 4
 
     # --- response/dispatch cache († response_cache.cc) ---
     # Capacity of the compiled-collective dispatch cache (signature -> jitted
@@ -152,6 +170,8 @@ _ENV_TABLE = [
     ("wire_precision", "WIRE_PRECISION", _parse_wire_precision),
     ("quant_block_size", "QUANT_BLOCK_SIZE", int),
     ("quant_min_bytes", "QUANT_MIN_BYTES", int),
+    ("sched_mode", "SCHED_MODE", _parse_sched_mode),
+    ("sched_chunks", "SCHED_CHUNKS", int),
     ("cache_capacity", "CACHE_CAPACITY", int),
     ("autotune", "AUTOTUNE", _parse_bool),
     ("autotune_log", "AUTOTUNE_LOG", str),

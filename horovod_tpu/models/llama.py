@@ -87,6 +87,18 @@ class LlamaConfig:
     # an opt-in memory lever for configs that don't otherwise fit, not a
     # default.
     blockwise_ce: bool = False
+    # Fused tp matmul + reduce-scatter on the decode projection layers
+    # (wo / w_down row-parallel psums in the stage-resident pp decode
+    # path), chunked so chunk c's reduce-scatter can overlap chunk c+1's
+    # partial matmul (ops/sched.matmul_reducescatter).  None = follow the
+    # engine's HOROVOD_TPU_SCHED_MODE knob (on when "decomposed");
+    # True/False force it.  Numerics: bit-identical at tp=2 (two-operand
+    # sums commute; token parity asserted in tests/test_sched.py) and
+    # within ~1 ulp beyond — psum and psum_scatter associate the tp-way
+    # sum in different ring orders (the same caveat as the engine's
+    # decomposed allreduce, docs/performance.md), so near-tie logits at
+    # tp>=4 could in principle pick a different token.
+    decode_tp_overlap: Optional[bool] = None
 
     @property
     def head_dim(self) -> int:
@@ -316,11 +328,17 @@ def _attn_block(h, lp, rope, cfg: LlamaConfig, attention):
     return h + jnp.einsum("bshk,hkd->bsd", attention(q, k, v), lp["wo"])
 
 
-def _dense_mlp(x2, lp):
-    """SwiGLU MLP shared by the scan and pipeline paths."""
+def _swiglu_hidden(x2, lp):
+    """SwiGLU gate/up half: ``silu(x@w_gate) * (x@w_up)`` — shared so the
+    decode path's fused down-projection reuses the same hidden math."""
     g = jax.nn.silu(jnp.einsum("bsd,df->bsf", x2, lp["w_gate"]))
     u = jnp.einsum("bsd,df->bsf", x2, lp["w_up"])
-    return jnp.einsum("bsf,fd->bsd", g * u, lp["w_down"])
+    return g * u
+
+
+def _dense_mlp(x2, lp):
+    """SwiGLU MLP shared by the scan and pipeline paths."""
+    return jnp.einsum("bsf,fd->bsd", _swiglu_hidden(x2, lp), lp["w_down"])
 
 
 # Test hook: route the TPU-gated flash branches through the Pallas
@@ -783,6 +801,25 @@ def _pick_token(logits, step_key, temperature, dtype):
         step_key, logits / temperature, axis=-1).astype(dtype)
 
 
+def _decode_tp_overlap_chunks(cfg: LlamaConfig, tp: int) -> int:
+    """Chunk count for the fused matmul+reduce-scatter decode projections
+    (0 = plain ``psum``).  ``cfg.decode_tp_overlap`` wins when set;
+    None follows the engine's schedule knob (``HOROVOD_TPU_SCHED_MODE``),
+    so one switch turns on decomposed collectives engine-wide AND the
+    decode-layer fusion."""
+    if tp <= 1:
+        return 0
+    from .. import context as ctx_mod
+    state = ctx_mod.global_state()
+    gcfg = state.config if state.initialized else None
+    enabled = cfg.decode_tp_overlap
+    if enabled is None:
+        enabled = gcfg is not None and gcfg.sched_mode == "decomposed"
+    if not enabled:
+        return 0
+    return max(2, gcfg.sched_chunks if gcfg is not None else 2)
+
+
 def _generate_pp(params: dict, prompt: jax.Array, cfg: LlamaConfig,
                  mesh: Mesh, max_new_tokens: int, temperature: float,
                  key: jax.Array) -> jax.Array:
@@ -815,6 +852,7 @@ def _generate_pp(params: dict, prompt: jax.Array, cfg: LlamaConfig,
     if B % dpf:
         raise ValueError(f"batch {B} must divide over dp*fsdp = {dpf}")
     scale = 1.0 / np.sqrt(Dh)
+    tp_chunks = _decode_tp_overlap_chunks(cfg, tp)
     dims = param_logical_dims(cfg)
     layer_dims = {k: d[1:] for k, d in dims["layers"].items()}
     layer_specs = jax.tree.map(lambda d: shd.spec_for(d), dims["layers"],
@@ -832,6 +870,16 @@ def _generate_pp(params: dict, prompt: jax.Array, cfg: LlamaConfig,
             out[k2] = leaf
         return out
 
+    def _row_parallel(x2, w2):
+        """tp row-parallel projection: ``psum(x2 @ w2)``, or — behind the
+        schedule knob — the fused chunked matmul + reduce-scatter
+        (ops/sched), which lets chunk c's collective overlap chunk c+1's
+        partial matmul on the decode critical path."""
+        if tp_chunks:
+            from ..ops.sched import matmul_reducescatter
+            return matmul_reducescatter(x2, w2, "tp", chunks=tp_chunks)
+        return lax.psum(jnp.matmul(x2, w2), "tp")
+
     def make_stage(rope, mask, write, attend_cache):
         def layer_step(h, inputs):
             lp, ck, cv = inputs
@@ -848,10 +896,14 @@ def _generate_pp(params: dict, prompt: jax.Array, cfg: LlamaConfig,
                 # T/Plen x the prefill attention FLOPs on masked slots
                 # (same reasoning as the non-pp prefill_layer).
                 attn = _cached_attend(q, k1, v1, mask, scale)
-            h = h + lax.psum(
-                jnp.einsum("bshk,hkd->bsd", attn, lp["wo"]), "tp")
-            h = h + lax.psum(
-                _dense_mlp(_rmsnorm(h, lp["mlp_norm"]), lp), "tp")
+            # Row-parallel wo / w_down: the decode projection layers the
+            # schedule IR fuses (matmul + reduce-scatter) when enabled.
+            Bq, Sq = attn.shape[0], attn.shape[1]
+            h = h + _row_parallel(
+                attn.reshape(Bq, Sq, -1),
+                lp["wo"].reshape(-1, lp["wo"].shape[-1]))
+            x2 = _rmsnorm(h, lp["mlp_norm"])
+            h = h + _row_parallel(_swiglu_hidden(x2, lp), lp["w_down"])
             return h, (ck, cv)
 
         def stage(h, layers_loc, ck_loc, cv_loc):

@@ -441,9 +441,23 @@ def _resolve_precision(precision: str, op: ReduceOp, x: jax.Array,
     return R.resolve_precision(precision, op, x.dtype, nbytes, cfg, n)
 
 
+def _resolve_schedule(schedule: str, op: ReduceOp, x: jax.Array, n: int,
+                      mode: str) -> str:
+    """Engine-default + per-call schedule -> the concrete descriptor
+    actually executed ("" = monolithic).  Same canonical-convention rule
+    as :func:`_resolve_precision`: enqueue-time and dispatch-time
+    resolution share this function so they can never drift apart."""
+    from . import sched as S
+    cfg = ctx_mod.global_state().config
+    nbytes = int(x.size * x.dtype.itemsize) // max(1, n)
+    return S.resolve_schedule(schedule, "allreduce", op, x.dtype, nbytes,
+                              cfg, n, mode)
+
+
 def allreduce(x: Any, op: ReduceOp = ReduceOp.AVERAGE, *,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
-              precision: str = "", process_set=None) -> jax.Array:
+              precision: str = "", schedule: str = "",
+              process_set=None) -> jax.Array:
     """Reduce a per-rank tensor across ranks; result replicated.
 
     † ``EnqueueTensorAllreduce`` / ``MPI_Allreduce`` / ``ncclAllReduce``;
@@ -451,6 +465,9 @@ def allreduce(x: Any, op: ReduceOp = ReduceOp.AVERAGE, *,
     ``precision`` selects the wire mode (see :mod:`ops.reduction`);
     empty defers to ``config.wire_precision`` and falls back to fp32
     whenever the mode cannot apply (non-float, non-sum, too small).
+    ``schedule`` selects the collective schedule (see :mod:`ops.sched`):
+    empty defers to ``config.sched_mode``; the decomposed schedule runs
+    the chunked reduce-scatter/allgather pipeline with identical results.
     """
     if op is ReduceOp.ADASUM:
         from . import adasum
@@ -459,6 +476,13 @@ def allreduce(x: Any, op: ReduceOp = ReduceOp.AVERAGE, *,
     x = as_per_rank(x, process_set)
     n = mesh.shape[axis]
     mode = _resolve_precision(precision, op, x, n)
+    sched_desc = _resolve_schedule(schedule, op, x, n, mode)
+    if sched_desc:
+        from .sched import executor as SE
+        return SE.execute_allreduce(
+            [x], op, descriptor=sched_desc, precision=mode,
+            prescale=float(prescale_factor),
+            postscale=float(postscale_factor), process_set=process_set)[0]
     if mode != "fp32":
         from . import reduction as R
         cfg = ctx_mod.global_state().config
@@ -500,14 +524,17 @@ def allreduce(x: Any, op: ReduceOp = ReduceOp.AVERAGE, *,
 def grouped_allreduce(xs: Sequence[Any], op: ReduceOp = ReduceOp.AVERAGE, *,
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0,
-                      precision: str = "",
+                      precision: str = "", schedule: str = "",
                       process_set=None) -> list[jax.Array]:
     """Fused allreduce of several tensors in one program/collective.
 
     † grouped allreduce (v0.21) and the implicit fusion of
     † ``fusion_buffer_manager.cc``.  ``precision`` applies the wire mode
     to the whole fused buffer (the engine fuses same-precision entries
-    together, so one quantized program covers the group).
+    together, so one quantized program covers the group); ``schedule``
+    likewise applies to the fused buffer — the decomposed pipeline chunks
+    the concatenated payload, so per-chunk overlap spans tensor
+    boundaries.
     """
     if not xs:
         return []
@@ -522,7 +549,7 @@ def grouped_allreduce(xs: Sequence[Any], op: ReduceOp = ReduceOp.AVERAGE, *,
             sub = grouped_allreduce([arrs[i] for i in idxs], op,
                                     prescale_factor=prescale_factor,
                                     postscale_factor=postscale_factor,
-                                    precision=precision,
+                                    precision=precision, schedule=schedule,
                                     process_set=process_set)
             for i, r in zip(idxs, sub):
                 out[i] = r
@@ -541,6 +568,18 @@ def grouped_allreduce(xs: Sequence[Any], op: ReduceOp = ReduceOp.AVERAGE, *,
     total_bytes = int(sum(numels)) * arrs[0].dtype.itemsize
     mode = R.resolve_precision(precision, op, arrs[0].dtype, total_bytes,
                                cfg, n)
+    if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        from . import sched as S
+        sched_desc = S.resolve_schedule(schedule, "allreduce", op,
+                                        arrs[0].dtype, total_bytes, cfg, n,
+                                        mode)
+        if sched_desc:
+            # Wire accounting happens inside the executor.
+            from .sched import executor as SE
+            return SE.execute_allreduce(
+                arrs, op, descriptor=sched_desc, precision=mode,
+                prescale=float(prescale_factor),
+                postscale=float(postscale_factor), process_set=process_set)
     block = cfg.quant_block_size
     hier = _hier_split(process_set)
     if hier is not None and (mode != "fp32" or not (

@@ -97,6 +97,10 @@ class TensorTableEntry:
     # every rank derives it from the same (op, dtype, size, config) and
     # fused groups / negotiation signatures agree.  "" = fp32 default.
     precision: str = ""
+    # Collective schedule descriptor (ops/sched): "" = monolithic, else
+    # a concrete "rs_ag:<chunks>".  Resolved at enqueue time under the
+    # same determinism contract as ``precision``.
+    schedule: str = ""
     enqueue_time: float = field(default_factory=time.monotonic)
     # Timeline phase currently open for this entry ("" | QUEUE | NEGOTIATE);
     # † timeline.cc tracks the same per-tensor lifecycle state.
@@ -135,6 +139,13 @@ class TensorTableEntry:
             # fp32 (the implicit default) is omitted so default-mode
             # metas stay byte-identical with pre-wire-precision peers.
             m["wp"] = self.precision
+        if self.schedule:
+            # Same contract for the schedule: a joined rank must rebuild
+            # the identical decomposed program (chunk count included) or
+            # the per-chunk XLA dispatches diverge across ranks.
+            # Monolithic ("") is omitted, keeping default-mode metas
+            # byte-identical with pre-schedule-IR peers.
+            m["sc"] = self.schedule
         return json.dumps(m, separators=(",", ":"))
 
 
@@ -173,6 +184,12 @@ def _parse_joinable_meta(meta: str) -> Optional[dict]:
             # Unknown wire mode from a version-skewed peer: we could not
             # build a matching program — skip, don't crash the cycle.
             return None
+        if m.get("sc", ""):
+            from .sched import parse_descriptor
+            if parse_descriptor(m["sc"]) is None:
+                # Unknown schedule lowering from a version-skewed peer:
+                # same rule — skip, don't crash the cycle.
+                return None
     except (ValueError, TypeError, KeyError):
         return None
     return m
@@ -637,7 +654,8 @@ class CollectiveEngine:
             name=name, verb=m["v"], payload=payload,
             op=C.ReduceOp(m["o"]), root_rank=m.get("r", 0),
             splits=m.get("sp"), prescale=m.get("ps", 1.0),
-            postscale=m.get("po", 1.0), precision=m.get("wp", ""))
+            postscale=m.get("po", 1.0), precision=m.get("wp", ""),
+            schedule=m.get("sc", ""))
 
     @staticmethod
     def _entry_bytes(e: TensorTableEntry) -> int:
@@ -666,9 +684,12 @@ class CollectiveEngine:
                 # "" (entries built without API resolution, e.g. join
                 # zero-participation for default-mode tensors) IS fp32 —
                 # normalized here so both fuse identically on all ranks.
+                # Same rule for the schedule: decomposed entries fuse
+                # only with same-descriptor entries (one chunked program
+                # per fused buffer; "" IS monolithic).
                 key = ("allreduce", e.op, str(e.payload.dtype),
                        id(e.process_set), e.prescale, e.postscale,
-                       e.precision or "fp32")
+                       e.precision or "fp32", e.schedule)
                 if key not in groups:
                     groups[key] = []
                     order.append(key)
@@ -741,16 +762,35 @@ class CollectiveEngine:
     def _dispatch(self, group: list[TensorTableEntry]) -> list[Any]:
         e0 = group[0]
         if e0.verb == "allreduce":
+            if e0.schedule and e0.op is not C.ReduceOp.ADASUM:
+                # Decomposed schedule (ops/sched): walk the chunked
+                # reduce-scatter/allgather pipeline, overlapping later
+                # chunks' communication with earlier chunks' compute.
+                # The whole fused group rides one schedule (fusion key
+                # includes the descriptor, so the group is homogeneous).
+                from .sched import executor as SE
+                label = (e0.name if len(group) == 1
+                         else f"hvd.fused[{len(group)}].{e0.name}")
+                return SE.execute_allreduce(
+                    [e.payload for e in group], e0.op,
+                    descriptor=e0.schedule,
+                    precision=e0.precision or "fp32",
+                    prescale=e0.prescale, postscale=e0.postscale,
+                    process_set=e0.process_set, name=label)
+            # schedule="monolithic" pins the dispatch to the enqueue-time
+            # resolution — C.allreduce must not re-resolve from config
+            # (the entry's schedule was agreed across ranks at enqueue).
             if len(group) == 1:
                 return [C.allreduce(e0.payload, e0.op,
                                     prescale_factor=e0.prescale,
                                     postscale_factor=e0.postscale,
                                     precision=e0.precision or "fp32",
+                                    schedule="monolithic",
                                     process_set=e0.process_set)]
             return C.grouped_allreduce(
                 [e.payload for e in group], e0.op,
                 prescale_factor=e0.prescale, postscale_factor=e0.postscale,
-                precision=e0.precision or "fp32",
+                precision=e0.precision or "fp32", schedule="monolithic",
                 process_set=e0.process_set)
         assert len(group) == 1
         if e0.verb == "allgather":

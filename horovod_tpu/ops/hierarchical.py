@@ -23,19 +23,33 @@ this process's device count): ``ops/collectives.allreduce`` and the fused
 ``grouped_allreduce`` route SUM/AVERAGE reductions through the two-level
 kernel when the split is valid, including batches fused by the engine.
 The standalone entries below also work directly on explicit 2-D meshes.
+
+Schedule IR (ops/sched): the two-level pipeline is expressed as an IR
+schedule — ``reduce_scatter@local -> all_reduce@cross -> combine ->
+all_gather@local`` (:func:`horovod_tpu.ops.sched.lower_hierarchical`) —
+and interpreted in-graph, so the hierarchical path and the engine's
+chunked decomposition share one step vocabulary.  Behavior is identical
+to the previous hand-written lowering (same ops, same order, same
+numbers); what the IR adds is the seed for a topology-aware lowering
+that chunks *and* tiers (ROADMAP item 3).
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Optional
+from functools import lru_cache
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 from jax import lax
-from ..jaxcompat import axis_size, shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ..jaxcompat import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+@lru_cache(maxsize=None)
+def hierarchical_schedule(local_axis: str, cross_axis: str):
+    """The two-tier IR schedule for an axis pair (cached: lowering is a
+    pure function of the axis names)."""
+    from .sched import lower_hierarchical
+    return lower_hierarchical(local_axis, cross_axis)
 
 
 def hierarchical_allreduce_local(v: jax.Array, *, local_axis: str,
@@ -44,28 +58,14 @@ def hierarchical_allreduce_local(v: jax.Array, *, local_axis: str,
     """Two-level allreduce inside a mapped context over both axes.
 
     v: this device's full tensor [*shape] (replic-intent).  Returns the
-    global sum (or mean) with the cross-axis hop carrying 1/n_local bytes.
+    global sum (or mean) with the cross-axis hop carrying 1/n_local
+    bytes.  Lowered through the schedule IR (module docstring): the
+    interpreter executes reduce-scatter over ICI, allreduce over DCN on
+    the 1/n_local shard, and all-gather back over ICI.
     """
-    n_local = axis_size(local_axis)
-    n_cross = axis_size(cross_axis)
-    shape = v.shape
-    flat = v.reshape(-1)
-    pad = (-flat.size) % n_local
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    # 1. ICI reduce-scatter: each local rank ends with 1/n_local of the sum.
-    shard = lax.psum_scatter(flat, local_axis, scatter_dimension=0,
-                             tiled=True)
-    # 2. DCN allreduce on the shard only.
-    shard = lax.psum(shard, cross_axis)
-    # 3. ICI all-gather back to the full tensor.
-    full = lax.all_gather(shard, local_axis, axis=0, tiled=True)
-    if pad:
-        full = full[:-pad]
-    out = full.reshape(shape)
-    if average:
-        out = out / (n_local * n_cross)
-    return out
+    from .sched import run_in_context
+    return run_in_context(hierarchical_schedule(local_axis, cross_axis),
+                          v, average=average)
 
 
 def hierarchical_allreduce(x: jax.Array, mesh: Mesh, *,

@@ -1,0 +1,103 @@
+"""Collective schedule IR: decomposed collectives with compute overlap.
+
+A small schedule-as-data layer (GC3-style; see PAPERS.md) under the
+collective engine.  Four pieces:
+
+- :mod:`.ir` — the step/schedule data model with stable signatures;
+- :mod:`.lower` — deterministic lowering passes (allreduce -> chunked
+  reduce-scatter/allgather; two-tier hierarchical);
+- :mod:`.executor` — the engine-side walk that dispatches steps so later
+  chunks' communication overlaps earlier chunks' compute;
+- :mod:`.in_context` — in-jit entry points (``overlap_allreduce``, the
+  ``matmul_reducescatter`` fused projection, the ``run_in_context``
+  interpreter the hierarchical path rides).
+
+Mode selection mirrors the wire-precision convention: the engine default
+comes from ``HOROVOD_TPU_SCHED_MODE`` (``monolithic``/``decomposed``) +
+``HOROVOD_TPU_SCHED_CHUNKS``; :func:`resolve_schedule` turns it into a
+concrete descriptor (``"rs_ag:4"``) deterministically from values every
+rank agrees on, and the descriptor rides the negotiation meta (``sc``
+field, next to ``wp``) so joined/zero-participation ranks rebuild
+identical programs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .ir import KINDS, Schedule, ScheduleError, Step  # noqa: F401
+from .lower import (  # noqa: F401
+    SCHED_MODES,
+    chunk_layout,
+    descriptor,
+    lower_allreduce,
+    lower_hierarchical,
+    parse_descriptor,
+)
+from .in_context import (  # noqa: F401
+    matmul_reducescatter,
+    overlap_allreduce,
+    run_in_context,
+)
+
+
+def resolve_schedule(requested: str, verb: str, op: Any, dtype: Any,
+                     nbytes: int, cfg, n: int, mode: str) -> str:
+    """Decide the schedule for one collective — deterministically, from
+    values every rank agrees on (verb, op, dtype, size, synchronized
+    config, resolved wire mode), the same contract as
+    :func:`reduction.resolve_precision`.
+
+    ``requested`` is the per-call override: ``""`` defers to
+    ``cfg.sched_mode``; ``"monolithic"``/``"decomposed"`` name the mode;
+    a concrete ``"rs_ag:<k>"`` descriptor passes through.  Returns
+    ``""`` (monolithic) or a concrete descriptor.  Falls back to
+    monolithic whenever decomposition cannot apply: non-allreduce verbs,
+    non-sum reductions, non-float payloads, single-rank meshes, payloads
+    too small to cut into >= 2 chunks, hierarchical mode (the two-tier
+    path owns its own schedule — see ``ops/hierarchical.py``), and the
+    bf16/fp16 **cast** wire modes — their monolithic form casts once and
+    rides a single psum whose ring is already 2-byte end to end, so a
+    decomposed variant would either re-round the combined shard onto the
+    cast grid a second time (diverging from the monolithic result) or
+    gather at 4 bytes (forfeiting the wire saving it is credited for).
+    """
+    import jax.numpy as jnp
+    from ..collectives import ReduceOp
+    from .. import reduction as R
+
+    req = requested or getattr(cfg, "sched_mode", "monolithic") \
+        or "monolithic"
+    if req == "monolithic":
+        return ""
+    if req == "decomposed":
+        k = max(1, int(getattr(cfg, "sched_chunks", 4)))
+    else:
+        k = parse_descriptor(req)
+        if k is None:
+            raise ValueError(
+                f"unknown schedule {req!r}; expected 'monolithic', "
+                "'decomposed' or 'rs_ag:<chunks>'")
+    if verb != "allreduce" or n <= 1 or k < 2:
+        return ""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        return ""
+    try:
+        if not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+            return ""
+        itemsize = jnp.dtype(dtype).itemsize
+    except TypeError:
+        return ""
+    if getattr(cfg, "hierarchical_allreduce", False):
+        return ""
+    if mode in ("bf16", "fp16"):
+        return ""   # cast wire keeps the single-psum shape (docstring)
+    # Size gate: need at least 2 schedulable units or there is nothing
+    # to overlap (one unit per rank-group for fp32, one block-aligned
+    # rank-group for quantized modes).
+    unit = (n * getattr(cfg, "quant_block_size", 512)
+            if mode in R.QUANT_MODES else n)
+    numel = max(1, nbytes // max(1, itemsize))
+    if numel < 2 * unit:
+        return ""
+    return descriptor(k)

@@ -1,0 +1,151 @@
+"""Lowering passes: collective verb + parameters -> :class:`~.ir.Schedule`.
+
+Everything here is a pure function of values every rank agrees on
+(shape, dtype, reduce op, wire mode, chunk count, synchronized config),
+so two processes — or a joined rank rebuilding from a negotiation meta —
+always produce byte-identical schedules and therefore identical compiled
+programs.  That invariant is what lets the engine carry only the compact
+descriptor (``"rs_ag:4"``) through negotiation, next to the ``wp`` wire
+mode field.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional
+
+from .ir import Schedule, _Builder
+
+#: Descriptor grammar for negotiation metas: the only schedule family the
+#: engine currently lowers is the chunked reduce-scatter/allgather
+#: decomposition.  Unknown descriptors from version-skewed peers must be
+#: rejected (parse -> None), never guessed at.
+_DESC_RE = re.compile(r"^rs_ag:(\d+)$")
+
+#: Schedule-mode config values (``HOROVOD_TPU_SCHED_MODE``).
+SCHED_MODES = ("monolithic", "decomposed")
+
+
+def parse_descriptor(desc: str) -> Optional[int]:
+    """``"rs_ag:<k>"`` -> chunk count k, or None when malformed/unknown.
+
+    The joined-rank half of schedule agreement: a meta whose ``sc`` field
+    does not parse means a peer runs a lowering this build does not know
+    — the entry must be skipped (exactly like an unknown ``wp`` mode),
+    not crash the cycle thread.
+    """
+    m = _DESC_RE.match(desc or "")
+    if not m:
+        return None
+    k = int(m.group(1))
+    return k if k >= 1 else None
+
+
+def descriptor(chunks: int) -> str:
+    return f"rs_ag:{int(chunks)}"
+
+
+def chunk_layout(numel: int, n: int, chunks: int, mode: str,
+                 block: int) -> list:
+    """Per-chunk element counts for a decomposed allreduce payload.
+
+    The flat payload is zero-padded to ``plen`` — a multiple of the
+    *unit* — and split into at most ``chunks`` contiguous pieces, each a
+    whole number of units:
+
+    - fp32/cast modes: unit = ``n`` (psum_scatter shards must divide
+      evenly across ranks);
+    - quantized modes: unit = ``n * block`` (shard boundaries must also
+      land on block-scale boundaries, and — deliberately — on the SAME
+      boundaries the monolithic quantized kernel uses, so the decomposed
+      result is bit-identical to it: per-block scales, exact narrow-
+      accumulator sums and per-block requantization are all independent
+      of which chunk a block lands in).
+
+    Returns the chunk lengths (summing to plen >= numel); the effective
+    chunk count is ``len(result)`` <= ``chunks`` (a payload with fewer
+    units than requested chunks degrades gracefully).
+    """
+    if numel < 1 or n < 1 or chunks < 1:
+        raise ValueError(f"bad chunk layout inputs ({numel}, {n}, {chunks})")
+    from ..reduction import QUANT_MODES
+    unit = n * block if mode in QUANT_MODES else n
+    units_total = max(1, math.ceil(numel / unit))
+    k = min(chunks, units_total)
+    base, rem = divmod(units_total, k)
+    # Deterministic spread: the first ``rem`` chunks get one extra unit.
+    return [(base + (1 if c < rem else 0)) * unit for c in range(k)]
+
+
+def lower_allreduce(numel: int, n: int, *, op_average: bool, mode: str,
+                    chunks: int, axis: str, block: int = 512) -> Schedule:
+    """Fused-allreduce group -> chunked reduce-scatter/allgather schedule.
+
+    Per chunk *c* the pipeline is::
+
+        [encode(c)] -> reduce_scatter(c) -> combine(c) -> all_gather(c)
+                       \\_______ comm ____/   \\ compute /   \\__ comm __/
+
+    where for quantized modes ``encode`` is the shared-scale block
+    quantization (folded into the same dispatch as the reduce-scatter —
+    XLA fuses them; the IR keeps it explicit so signatures say what the
+    wire carries), ``combine`` is the fp32 dequant-accumulate + average +
+    local-scale requant, and ``all_gather`` moves the 1-byte payload +
+    scales and decodes.  For fp32, ``encode`` is elided and ``combine``
+    is the average (elided again for SUM — nothing to compute).
+
+    A leading ``chunk`` DATA step models the flatten/concat/pad split and
+    a trailing ``concat`` step models reassembly; ``barrier`` is not
+    emitted here (the rs_ag DAG's only joins are per-chunk edges) but the
+    executor honors it for hand-built schedules.
+    """
+    b = _Builder()
+    layout = chunk_layout(numel, n, chunks, mode, block)
+    k = len(layout)
+    quant = mode in ("int8", "fp8")
+    split = b.add("chunk")
+    tails = []
+    for c in range(k):
+        prev = split
+        if quant:
+            prev = b.add("encode", chunk=c, mode=mode, deps=[prev])
+        rs = b.add("reduce_scatter", chunk=c, axis=axis, deps=[prev])
+        prev = rs
+        if quant or op_average:
+            # Quantized: dequant-accumulate (+average) + requant.
+            # fp32 AVERAGE: the divide.  fp32 SUM: no compute step.
+            prev = b.add("combine", chunk=c, mode=mode if quant else "",
+                         deps=[prev])
+        ag = b.add("all_gather", chunk=c, axis=axis, deps=[prev])
+        prev = ag
+        if quant:
+            prev = b.add("decode", chunk=c, mode=mode, deps=[prev])
+        tails.append(prev)
+    b.add("concat", deps=tails)
+    return b.build("rs_ag", chunks=k, mode=mode,
+                   descriptor=descriptor(chunks))
+
+
+def lower_hierarchical(local_axis: str, cross_axis: str) -> Schedule:
+    """Two-tier allreduce as an IR schedule (ROADMAP item 3 seed).
+
+    The reference's ``HOROVOD_HIERARCHICAL_ALLREDUCE`` shape — NCCL
+    reduce-scatter within the node, MPI allreduce across, NCCL allgather
+    back — expressed as three steps on two tiers::
+
+        reduce_scatter@local -> all_reduce@cross -> all_gather@local
+
+    ``ops/hierarchical.py`` builds this schedule and interprets it
+    in-graph (:func:`horovod_tpu.ops.sched.in_context.run_in_context`),
+    so the two-level path and the engine's chunked path share one step
+    vocabulary — the prerequisite for a topology-aware lowering that
+    chunks *and* tiers.
+    """
+    b = _Builder()
+    rs = b.add("reduce_scatter", chunk=0, axis=local_axis)
+    ar = b.add("all_reduce", chunk=0, axis=cross_axis, deps=[rs])
+    cb = b.add("combine", chunk=0, deps=[ar])
+    b.add("all_gather", chunk=0, axis=local_axis, deps=[cb])
+    return b.build("hier", chunks=1, mode="fp32",
+                   descriptor=f"hier:{local_axis}/{cross_axis}")

@@ -1,4 +1,5 @@
-"""Online autotuning of fusion-threshold, cycle-time and wire precision.
+"""Online autotuning of fusion-threshold, cycle-time, wire precision and
+collective schedule.
 
 † ``horovod/common/parameter_manager.cc`` + ``optim/bayesian_optimization.cc``:
 the reference tunes (fusion threshold, cycle time) online with Bayesian
@@ -11,19 +12,24 @@ kernel + expected improvement over a candidate grid).  Eigen/LBFGS hyperparam
 refits are replaced by a small fixed-length-scale kernel — adequate for a
 low-noise search space.
 
-Knob space, v2: 3-D.  Beyond the reference's (threshold, cycle-time), the
-third dimension is the engine's **wire precision** (``ops/reduction.py``):
-fp32, bf16, or block-scaled int8.  The score is *effective* bytes/s —
-logical fp32 payload bytes per cycle second — so a mode that moves fewer
-wire bytes in less time scores higher, and the GP picks the precision the
-interconnect actually rewards (on TPU, quantized; on the CPU rig, whose
-collectives are byte-width-insensitive, it correctly learns fp32).
+Knob space, v3: 4-D.  Beyond the reference's (threshold, cycle-time),
+the third dimension is the engine's **wire precision**
+(``ops/reduction.py``): fp32, bf16, or block-scaled int8; the fourth is
+the **collective schedule** (``ops/sched``): monolithic vs the
+decomposed reduce-scatter/allgather pipeline at a candidate chunk count.
+The score is *effective* bytes/s — logical fp32 payload bytes per cycle
+second — so a mode that moves fewer wire bytes (or overlaps more of its
+communication) in less time scores higher, and the GP picks what the
+interconnect actually rewards (on TPU, quantized + decomposed; on the
+CPU rig, whose collectives are byte-width-insensitive and serialized, it
+correctly learns fp32 + monolithic).
 
-Multi-process jobs pin the precision dimension to the configured
-default: each rank scores from rank-local timings, and a per-rank
-precision commit would resolve the same tensor to different wire modes
-on different ranks — divergent fused programs, a hang.  Single-
-controller mode (one process, all devices) tunes all three dimensions.
+Multi-process jobs pin the precision AND schedule dimensions to the
+configured defaults: each rank scores from rank-local timings, and a
+per-rank commit of either would resolve the same tensor to different
+wire modes / chunk programs on different ranks at enqueue — divergent
+fused XLA dispatches across processes, i.e. a hang.  Single-controller
+mode (one process, all devices) tunes all four dimensions.
 
 Tensor-size bucketing: the precision knob governs the *quantizable
 bucket* — tensors at or above ``quant_min_bytes``.  Tensors below the
@@ -50,6 +56,10 @@ from ..obs import REGISTRY as _obs
 _THRESHOLDS = [1 << p for p in range(20, 28)]         # 1 MB .. 128 MB
 _CYCLE_TIMES = [0.5, 1.0, 2.5, 5.0, 10.0, 20.0]        # ms
 _WIRE_MODES = ["fp32", "bf16", "int8"]
+# Schedule dimension (ops/sched): monolithic vs decomposed at the chunk
+# counts worth searching (higher counts add dispatch overhead faster than
+# they add overlap window; 2 and 4 bracket the useful range).
+_SCHED_MODES = ["monolithic", "rs_ag:2", "rs_ag:4"]
 # GP-space spacing between adjacent modes; comparable to one grid step in
 # the log2-threshold dimension so no dimension dominates the RBF distance.
 _MODE_SCALE = 2.0
@@ -66,7 +76,7 @@ _m_cycle_ms = _obs.gauge(
 
 
 class _GP:
-    """Minimal RBF-kernel GP regressor for the 3-D knob space."""
+    """Minimal RBF-kernel GP regressor for the 4-D knob space."""
 
     def __init__(self, length_scale: float = 1.0, noise: float = 1e-3) -> None:
         self.ls = length_scale
@@ -104,11 +114,13 @@ def _expected_improvement(mu: np.ndarray, var: np.ndarray, best: float
 class Autotuner:
     """Propose/score loop attached to the engine's cycle callback."""
 
-    def _norm_point(self, threshold: int, cycle_ms: float, mode: str
-                    ) -> tuple[float, float, float]:
-        """Raw knobs -> GP coordinates (mode index is instance-local)."""
+    def _norm_point(self, threshold: int, cycle_ms: float, mode: str,
+                    sched: str) -> tuple[float, float, float, float]:
+        """Raw knobs -> GP coordinates (mode/sched indices are
+        instance-local)."""
         return (math.log2(threshold), math.log2(cycle_ms),
-                self._modes.index(mode) * _MODE_SCALE)
+                self._modes.index(mode) * _MODE_SCALE,
+                self._scheds.index(sched) * _MODE_SCALE)
 
     def __init__(self, state) -> None:
         self._state = state
@@ -131,13 +143,26 @@ class Autotuner:
         engine = getattr(state, "engine", None)
         distributed = bool(engine is not None and engine.distributed)
         default = cfg.wire_precision or "fp32"
+        # Schedule dimension, pinned in multi-process jobs for the same
+        # reason as the wire mode (module docstring): a per-rank
+        # sched_mode/sched_chunks commit diverges the enqueue-time
+        # schedule resolution across ranks.
+        sched_default = ("monolithic"
+                         if getattr(cfg, "sched_mode", "monolithic")
+                         != "decomposed"
+                         else f"rs_ag:{max(1, cfg.sched_chunks)}")
         if distributed:
             self._modes = [default]
+            self._scheds = [sched_default]
         else:
             self._modes = _WIRE_MODES + (
                 [default] if default not in _WIRE_MODES else [])
-        self._grid_raw = [(t, c, m) for t in _THRESHOLDS
-                          for c in _CYCLE_TIMES for m in self._modes]
+            self._scheds = _SCHED_MODES + (
+                [sched_default] if sched_default not in _SCHED_MODES
+                else [])
+        self._grid_raw = [(t, c, m, s) for t in _THRESHOLDS
+                          for c in _CYCLE_TIMES for m in self._modes
+                          for s in self._scheds]
         self._grid = np.array([self._norm_point(*p) for p in self._grid_raw])
         # Normalized GP inputs AND the exact raw grid knobs of each
         # sample.  Committing from the raw record (not a ``2 ** log2``
@@ -145,10 +170,11 @@ class Autotuner:
         # cycle-time exactly on the candidate grid — the round-trip
         # drifted (e.g. 2.5 ms -> 2.4999999999999996) so the converged
         # knobs were values no candidate ever proposed.
-        self._samples_X: list[tuple[float, float, float]] = []
-        self._samples_raw: list[tuple[int, float, str]] = []
+        self._samples_X: list[tuple[float, float, float, float]] = []
+        self._samples_raw: list[tuple[int, float, str, str]] = []
         self._samples_y: list[float] = []
-        self._current = (cfg.fusion_threshold, cfg.cycle_time_ms, default)
+        self._current = (cfg.fusion_threshold, cfg.cycle_time_ms, default,
+                         sched_default)
         self._acc_bytes = 0
         self._acc_time = 0.0
         self._acc_cycles = 0
@@ -171,9 +197,9 @@ class Autotuner:
             self._warmup_left -= 1
             self._log(f"warmup score={score:.3e}")
             return
-        t, c, m = self._current
-        self._samples_X.append(self._norm_point(t, c, m))
-        self._samples_raw.append((t, c, m))
+        t, c, m, s = self._current
+        self._samples_X.append(self._norm_point(t, c, m, s))
+        self._samples_raw.append((t, c, m, s))
         self._samples_y.append(score)
         _m_trials.inc()
         _m_score.set(score)
@@ -188,31 +214,39 @@ class Autotuner:
         mu, var = gp.predict(self._grid)
         ei = _expected_improvement(mu, var, y_norm.max())
         idx = int(np.argmax(ei))
-        threshold, cycle, mode = self._grid_raw[idx]
-        self._apply(threshold, cycle, mode)
+        threshold, cycle, mode, sched = self._grid_raw[idx]
+        self._apply(threshold, cycle, mode, sched)
         best = int(np.argmax(y))
         self._log(
             f"sample #{len(y)} score={y[-1]:.3e} -> next "
             f"threshold={threshold} cycle_ms={cycle} wire={mode} "
-            f"(best so far {self._raw(best)} @ {y[best]:.3e})")
+            f"sched={sched} (best so far {self._raw(best)} @ {y[best]:.3e})")
         # Convergence: stop after exploring enough with no improvement,
         # committing the best-seen knobs († ParameterManager stops tuning).
         if len(y) >= 12 and best < len(y) - 6:
-            bt, bc, bm = self._raw(best)
-            self._apply(bt, bc, bm)
+            bt, bc, bm, bs = self._raw(best)
+            self._apply(bt, bc, bm, bs)
             self._done = True
-            self._log(f"converged: threshold={bt} cycle_ms={bc} wire={bm}")
+            self._log(f"converged: threshold={bt} cycle_ms={bc} "
+                      f"wire={bm} sched={bs}")
 
-    def _raw(self, i: int) -> tuple[int, float, str]:
+    def _raw(self, i: int) -> tuple[int, float, str, str]:
         """Exact grid knobs of sample *i* — from the raw record, never a
         ``2 ** log2(x)`` round-trip of the normalized GP coordinates."""
         return self._samples_raw[i]
 
-    def _apply(self, threshold: int, cycle_ms: float, mode: str) -> None:
-        self._current = (threshold, cycle_ms, mode)
+    def _apply(self, threshold: int, cycle_ms: float, mode: str,
+               sched: str) -> None:
+        from ..ops.sched import parse_descriptor
+        self._current = (threshold, cycle_ms, mode, sched)
         self._state.config.fusion_threshold = threshold
         self._state.config.cycle_time_ms = cycle_ms
         self._state.config.wire_precision = mode
+        if sched == "monolithic":
+            self._state.config.sched_mode = "monolithic"
+        else:
+            self._state.config.sched_mode = "decomposed"
+            self._state.config.sched_chunks = parse_descriptor(sched)
         _m_threshold.set(threshold)
         _m_cycle_ms.set(cycle_ms)
         from ..ops import reduction as _R

@@ -162,7 +162,7 @@ def test_autotuner_proposes_and_converges(tmp_path):
     for i in range(200):
         if at._done:
             break
-        t, c, m = at._current
+        t, c, m, s = at._current
         score_bias = 1.0 + (np.log2(t) - 20) * 0.1
         at.record_cycle(int(1e6 * score_bias), 0.001)
     log = (tmp_path / "at.log").read_text()
@@ -176,9 +176,11 @@ def test_autotuner_commits_exact_grid_values(tmp_path):
     """Regression: the converged knobs must be EXACT candidate-grid
     values.  The old ``_raw`` reconstructed them as ``2 ** log2(x)`` from
     the normalized GP samples, which drifted the committed cycle time off
-    the grid (2.5 -> 2.4999999999999996)."""
+    the grid (2.5 -> 2.4999999999999996).  The 4th (schedule) dimension
+    joins the same assertion so the knob-space growth cannot reintroduce
+    the drift through a new code path."""
     from horovod_tpu.utils.autotune import (
-        Autotuner, _CYCLE_TIMES, _THRESHOLDS, _WIRE_MODES)
+        Autotuner, _CYCLE_TIMES, _SCHED_MODES, _THRESHOLDS, _WIRE_MODES)
 
     class FakeState:
         pass
@@ -196,7 +198,7 @@ def test_autotuner_commits_exact_grid_values(tmp_path):
         # Flat-ish noisy scores: convergence picks SOME sampled config.
         at.record_cycle(int(1e6 + rng.randint(0, 1000)), 0.001)
     assert at._done, "tuner never converged"
-    t, c, m = at._current
+    t, c, m, s = at._current
     assert t in _THRESHOLDS or t == st.config.fusion_threshold
     assert st.config.fusion_threshold == t
     # The drift bug showed up in the float knob: exact membership now.
@@ -204,11 +206,47 @@ def test_autotuner_commits_exact_grid_values(tmp_path):
     assert st.config.cycle_time_ms == c
     assert m in _WIRE_MODES
     assert st.config.wire_precision == m
+    assert s in _SCHED_MODES
+    if s == "monolithic":
+        assert st.config.sched_mode == "monolithic"
+    else:
+        assert st.config.sched_mode == "decomposed"
+        assert f"rs_ag:{st.config.sched_chunks}" == s
     # Every recorded sample keeps exact raw knobs alongside the GP coords.
-    for (rt, rc, rm), (xt, xc, xm) in zip(at._samples_raw, at._samples_X):
+    for (rt, rc, rm, rs), (xt, xc, xm, xs) in zip(at._samples_raw,
+                                                  at._samples_X):
         assert rt in _THRESHOLDS or rt == 64 * 1024 * 1024
         assert rc in _CYCLE_TIMES or rc == 2.5
+        assert rs in _SCHED_MODES
         assert 2.0 ** xt == pytest.approx(rt)
+
+
+def test_autotuner_pins_sched_and_mode_when_distributed():
+    """Multi-process engines must pin BOTH the wire-precision and the
+    schedule dimensions to the configured defaults: a per-rank commit of
+    either diverges the enqueue-time resolution across processes (hang).
+    """
+    from horovod_tpu.utils.autotune import Autotuner
+
+    class FakeEngine:
+        distributed = True
+
+    class FakeState:
+        pass
+
+    from horovod_tpu import config as config_mod
+    st = FakeState()
+    st.engine = FakeEngine()
+    st.config = config_mod.Config(
+        autotune=True, autotune_warmup_samples=0,
+        autotune_steps_per_sample=1, wire_precision="int8",
+        sched_mode="decomposed", sched_chunks=2)
+    at = Autotuner(st)
+    assert at._modes == ["int8"]
+    assert at._scheds == ["rs_ag:2"]
+    # And every grid candidate keeps them fixed.
+    assert {g[2] for g in at._grid_raw} == {"int8"}
+    assert {g[3] for g in at._grid_raw} == {"rs_ag:2"}
 
 
 @pytest.mark.integration

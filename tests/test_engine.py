@@ -209,6 +209,80 @@ def test_timeline_engine_phases(tmp_path):
         < spans.index("DISPATCH")
 
 
+def test_timeline_decomposed_overlap_spans(tmp_path):
+    """Acceptance gate for the schedule IR (ops/sched): with
+    HOROVOD_TPU_SCHED_MODE=decomposed, the dryrun trace must show at
+    least one communication step (SCHED_RS / SCHED_AG) overlapping a
+    compute span (SCHED_COMBINE), with the RS -> COMBINE -> AG flow
+    arrows linking each chunk's pipeline."""
+    import json
+    from horovod_tpu.utils.timeline import Timeline
+    state = hvd.global_state()
+    cfg = state.config
+    old_tl, old_mode, old_chunks = (state.timeline, cfg.sched_mode,
+                                    cfg.sched_chunks)
+    p = tmp_path / "sched_overlap.json"
+    state.timeline = Timeline(str(p))
+    cfg.sched_mode, cfg.sched_chunks = "decomposed", 3
+    try:
+        x = hvd.per_rank(
+            [np.random.RandomState(r).randn(6000).astype(np.float32)
+             for r in range(N)])
+        hvd.synchronize(hvd.allreduce_async(x, hvd.Average, name="t.ovl"))
+    finally:
+        state.timeline.close()
+        state.timeline, cfg.sched_mode, cfg.sched_chunks = (
+            old_tl, old_mode, old_chunks)
+    events = json.load(open(p))
+    lanes = {e["args"]["name"]: e["tid"] for e in events
+             if e.get("name") == "thread_name"}
+    sched_tids = {v for k, v in lanes.items()
+                  if any(t in k for t in ("/rs.", "/combine.", "/ag."))}
+    assert len(sched_tids) == 9, lanes            # 3 units x 3 chunks
+    # Reconstruct per-step in-flight intervals from B/E pairs.
+    open_ts, ivals = {}, {}
+    for e in events:
+        tid = e.get("tid")
+        if e.get("ph") == "B" and tid in sched_tids:
+            open_ts[tid] = (e["name"], e["ts"])
+        elif e.get("ph") == "E" and tid in open_ts:
+            nm, t0 = open_ts.pop(tid)
+            ivals.setdefault(nm, []).append((t0, e["ts"]))
+    assert {len(v) for v in ivals.values()} == {3}
+    comm = ivals["SCHED_RS"] + ivals["SCHED_AG"]
+    comp = ivals["SCHED_COMBINE"]
+    assert any(max(c0, k0) < min(c1, k1)
+               for c0, c1 in comm for k0, k1 in comp), (comm, comp)
+    # Flow arrows: one s/f pair per pipeline hop (RS->COMBINE,
+    # COMBINE->AG), on the schedule lanes, well-formed ids.
+    flows = [e for e in events
+             if e.get("cat") == "flow" and e.get("tid") in sched_tids]
+    starts = {e["id"] for e in flows if e["ph"] == "s"}
+    ends = {e["id"] for e in flows if e["ph"] == "f"}
+    assert starts and starts == ends, flows
+
+
+def test_decomposed_entries_through_engine_match_monolithic():
+    """Engine-path parity: the same payload allreduced with the
+    decomposed schedule resolved at enqueue must be bit-exact with the
+    monolithic dispatch (the CI np=2/4 job asserts the same over real
+    negotiated transport)."""
+    cfg = hvd.global_state().config
+    old_mode, old_chunks = cfg.sched_mode, cfg.sched_chunks
+    x = hvd.per_rank(
+        [np.random.RandomState(r).randn(4096).astype(np.float32)
+         for r in range(N)])
+    try:
+        ref = hvd.to_numpy(hvd.synchronize(
+            hvd.allreduce_async(x, hvd.Average, name="t.dm.mono")))
+        cfg.sched_mode, cfg.sched_chunks = "decomposed", 4
+        got = hvd.to_numpy(hvd.synchronize(
+            hvd.allreduce_async(x, hvd.Average, name="t.dm.dec")))
+    finally:
+        cfg.sched_mode, cfg.sched_chunks = old_mode, old_chunks
+    np.testing.assert_array_equal(ref, got)
+
+
 def test_join_covered_non_allreduce_errors():
     """A non-allreduce collective whose readiness depended on a joined
     rank's fabricated zeros must error on the ranks that own it — zeros in

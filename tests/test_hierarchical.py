@@ -118,3 +118,23 @@ def test_hierarchical_split_invalid_cases():
     finally:
         (state.config.hierarchical_allreduce,
          state.config.hierarchical_local_size) = old
+
+
+def test_hierarchical_rides_the_schedule_ir():
+    """The two-level path lowers through ops/sched (ROADMAP item 3 seed):
+    the IR schedule carries the tier structure, and the in-graph
+    interpreter reproduces the hand-written pipeline's numbers exactly
+    (default behavior unchanged)."""
+    from horovod_tpu.ops import hierarchical as H
+    from horovod_tpu.ops.sched import lower_hierarchical
+
+    s = H.hierarchical_schedule("hvd_local", "hvd_cross")
+    kinds = [(st.kind, st.axis) for st in s.steps if st.axis]
+    assert kinds == [("reduce_scatter", "hvd_local"),
+                     ("all_reduce", "hvd_cross"),
+                     ("all_gather", "hvd_local")]
+    # Cached + deterministic: same axes -> the same schedule object and
+    # an identical signature to a fresh lowering.
+    assert H.hierarchical_schedule("hvd_local", "hvd_cross") is s
+    assert s.signature() == lower_hierarchical(
+        "hvd_local", "hvd_cross").signature()

@@ -143,6 +143,24 @@ def test_hvdrun_quantized_allreduce_parity(np_):
 
 
 @pytest.mark.integration
+@pytest.mark.parametrize("np_", [2, 4])
+def test_hvdrun_decomposed_allreduce_parity(np_):
+    """Decomposed (ops/sched) vs monolithic allreduce over real
+    negotiated transport: BIT-exact for int8/fp8 at both np=2 (the
+    ci.yaml decomposed-parity job) and np=4, BIT-exact for fp32 at np=2
+    and <=2-ulp at np=4 (ring association order — see the worker
+    docstring), plus mixed-schedule fusion-group consistency and the
+    join/rebuild path (a joined rank reconstructs the chunked program
+    from the meta's ``sc`` field; divergence hangs, so completion is
+    part of the assertion)."""
+    res = _hvdrun(np_, [os.path.join(REPO, "tests", "mp_sched_worker.py")],
+                  timeout=120 + 30 * np_)
+    assert res.returncode == 0, res.stdout + res.stderr
+    for r in range(np_):
+        assert f"rank {r}: SCHED-OK" in res.stdout, res.stdout
+
+
+@pytest.mark.integration
 def test_hvdrun_join_uneven_inputs():
     """† test_horovod_join: rank 0 runs 3 steps, rank 1 runs 5; the job
     completes (no deadlock) and surviving-step allreduces are correct."""
