@@ -671,6 +671,29 @@ def cluster_metrics(fmt: str = "dict"):
     return _format_snapshot(aggregate.cluster_snapshot(), fmt)
 
 
+def flight_record(path: Optional[str] = None) -> Optional[str]:
+    """Write a flight-recorder postmortem bundle NOW and return its path
+    (:mod:`horovod_tpu.obs.flightrec`).
+
+    The bundle holds the per-rank ring of recent events (trace spans,
+    collective dispatches, stall warnings, elastic interrupts), an
+    atomic metrics-registry snapshot, the process identity, and — in
+    multi-process mode — the coordinator's current straggler attribution
+    (missing-rank list + bitmap per stalled tensor).  The same bundle is
+    auto-dumped on stall-shutdown / round-abort / elastic failure /
+    crash when ``HOROVOD_TPU_FLIGHT_RECORDER_DIR`` (or
+    ``Config.flight_recorder_dir``) is set; this is the on-demand form
+    ("grab me a black box of the last N events") and works before/without
+    ``init()``.  ``path=None`` names a file under the armed directory
+    (or the CWD).  Returns None only if the dump itself failed (logged,
+    never raised)."""
+    state = global_state()
+    stall = None
+    if state.engine is not None:
+        stall = getattr(state.engine._negotiator, "last_stall_info", None)
+    return obs.flightrec.RECORDER.dump(path, reason="manual", stall=stall)
+
+
 def _format_snapshot(snap, fmt: str):
     if fmt == "dict":
         return snap

@@ -119,6 +119,28 @@ class Config:
     # TCP port for the Prometheus/JSON pull endpoint; None = no server.
     metrics_port: Optional[int] = None
 
+    # --- request tracing (obs/trace.py) ---
+    # Per-trace sampling probability in [0, 1]; 1.0 traces every serving
+    # request (the bench holds traced-on overhead under the 2% budget),
+    # 0 disables tracing entirely.
+    trace_sample: float = 1.0
+
+    # --- SLO engine (obs/slo.py) ---
+    # Semicolon-separated objective specs, e.g.
+    # "ttft=p99(ttft) < 250ms over 5m; p95(itl) < 50ms".  None = no SLO
+    # engine; armed at init(), gauges ride /metrics and /cluster.
+    slo: Optional[str] = None
+    # Seconds between SLO histogram snapshots / evaluations.
+    slo_tick_s: float = 10.0
+
+    # --- flight recorder (obs/flightrec.py) ---
+    # Directory for auto-dumped postmortem bundles (stall shutdown,
+    # round abort, elastic failure, crash).  None = manual
+    # hvd.flight_record() only; the ring still records either way.
+    flight_recorder_dir: Optional[str] = None
+    # Ring capacity in events (0 disables recording).
+    flight_recorder_size: int = 2048
+
     # --- stall inspector († stall_inspector.cc) ---
     stall_check: bool = True
     stall_warning_time_s: float = 60.0
@@ -180,6 +202,11 @@ _ENV_TABLE = [
     ("timeline", "TIMELINE", str),
     ("timeline_mark_cycles", "TIMELINE_MARK_CYCLES", _parse_bool),
     ("metrics_port", "METRICS_PORT", int),
+    ("trace_sample", "TRACE_SAMPLE", float),
+    ("slo", "SLO", str),
+    ("slo_tick_s", "SLO_TICK_SECONDS", float),
+    ("flight_recorder_dir", "FLIGHT_RECORDER_DIR", str),
+    ("flight_recorder_size", "FLIGHT_RECORDER_SIZE", int),
     ("stall_check", "STALL_CHECK_DISABLE", lambda v: not _parse_bool(v)),
     ("stall_warning_time_s", "STALL_CHECK_TIME_SECONDS", float),
     ("stall_shutdown_time_s", "STALL_SHUTDOWN_TIME_SECONDS", float),

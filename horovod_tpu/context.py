@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import atexit
 import threading
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -248,14 +249,23 @@ def init(
 
 
 def _arm_obs_plane() -> None:
-    """Register ``horovod_tpu_build_info`` and start cross-rank snapshot
-    publishing/aggregation (:mod:`horovod_tpu.obs.aggregate`).  Called
-    under the init lock; re-entrant across elastic re-inits (a changed
-    world size re-labels the info gauge and restarts the publisher)."""
+    """Register ``horovod_tpu_build_info`` and start the observability
+    tiers that need runtime identity: cross-rank snapshot publishing /
+    aggregation (:mod:`horovod_tpu.obs.aggregate`), the ``/healthz``
+    readiness provider, the flight recorder's identity + auto-dump
+    arming, the request tracer's sampling knob, and (when configured)
+    the SLO engine.  Called under the init lock; re-entrant across
+    elastic re-inits (a changed world size re-labels the info gauge and
+    restarts the publisher/SLO engine)."""
     from . import __version__ as version
     from .obs import REGISTRY as obs_registry
     from .obs import aggregate as obs_aggregate
+    from .obs import flightrec as obs_flightrec
+    from .obs import server as obs_server
+    from .obs import slo as obs_slo
+    from .obs import trace as obs_trace
 
+    cfg = _state.config
     dev = _state.devices[0]
     g = obs_registry.gauge(
         "horovod_tpu_build_info",
@@ -270,6 +280,49 @@ def _arm_obs_plane() -> None:
              device_kind=getattr(dev, "device_kind", dev.platform)).set(1)
     obs_aggregate.start_for_rank(jax.process_index(), jax.process_count())
 
+    # Request tracing: the config knob is the authoritative sample rate
+    # (it already folded the env surface in).
+    obs_trace.TRACER.sample_rate = cfg.trace_sample
+
+    # Flight recorder: identity for bundle headers; arming enables the
+    # engine/elastic auto-dumps and the crash excepthook.
+    obs_flightrec.RECORDER.set_identity(jax.process_index(),
+                                        jax.process_count())
+    obs_flightrec.RECORDER.set_capacity(cfg.flight_recorder_size)
+    if cfg.flight_recorder_dir:
+        obs_flightrec.RECORDER.arm(cfg.flight_recorder_dir)
+
+    # SLO engine: declarative objectives evaluated against the registry;
+    # gauges ride the snapshot path to /cluster with no extra wiring.
+    if cfg.slo:
+        obs_slo.arm(cfg.slo, tick_s=cfg.slo_tick_s)
+
+    # /healthz readiness: armed only while the runtime is up, so the
+    # shutdown->init window of an elastic re-rendezvous answers 503 and
+    # a router probe drops this replica from rotation.
+    obs_server.set_health_provider(_health_snapshot)
+
+
+def _health_snapshot() -> dict:
+    """The ``/healthz`` payload: is this rank able to serve/train right
+    now, and how fresh is its view of the job."""
+    eng = _state.engine
+    alive = bool(eng is not None and eng.alive)
+    d = {
+        "ready": bool(_state.initialized and alive),
+        "status": "ok" if (_state.initialized and alive) else "unready",
+        "rank": jax.process_index(),
+        "size": jax.process_count(),
+        "engine_alive": alive,
+        "uptime_s": round(time.monotonic() - _START_MONO, 3),
+    }
+    if eng is not None:
+        d["last_negotiation_age_s"] = round(eng.last_negotiation_age_s, 3)
+    return d
+
+
+_START_MONO = time.monotonic()
+
 
 def shutdown() -> None:
     """Stop the background engine († ``horovod_shutdown``)."""
@@ -277,7 +330,13 @@ def shutdown() -> None:
         if not _state.initialized:
             return
         from .obs import aggregate as obs_aggregate
+        from .obs import server as obs_server
+        from .obs import slo as obs_slo
         obs_aggregate.stop()
+        obs_slo.disarm()
+        # /healthz answers 503 from here until the next init() — the
+        # elastic re-rendezvous window a router probe must see as down.
+        obs_server.set_health_provider(None)
         if _state.engine is not None:
             _state.engine.stop()
             _state.engine = None

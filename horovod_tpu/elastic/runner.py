@@ -15,6 +15,7 @@ import os
 from typing import Any, Callable, Optional
 
 from ..obs import REGISTRY as _obs
+from ..obs import flightrec as _frec
 from ..ops.engine import HorovodInternalError
 from ..utils import logging as hvd_logging
 
@@ -115,6 +116,14 @@ def run(func: Callable[..., Any]) -> Callable[..., Any]:
                 return func(state, *args, **kwargs)
             except HorovodInternalError as e:
                 _m_interrupts.labels(kind="failure").inc()
+                # Black-box the failure before recovery tears state down:
+                # the ring (recent collectives, stall warnings, spans)
+                # plus the registry is exactly what the postmortem needs
+                # and exactly what the restart erases.
+                _frec.RECORDER.record("elastic_interrupt", name="failure",
+                                      error=str(e))
+                _frec.RECORDER.maybe_dump("elastic_failure",
+                                          extra={"error": str(e)})
                 if os.environ.get("HVDTPU_ELASTIC") == "1":
                     # Under the ElasticDriver the job — not the process —
                     # is the recovery unit (static mesh + controller in
@@ -135,6 +144,8 @@ def run(func: Callable[..., Any]) -> Callable[..., Any]:
                 state.restore()
             except HostsUpdatedInterrupt as e:
                 _m_interrupts.labels(kind="hosts_updated").inc()
+                _frec.RECORDER.record("elastic_interrupt",
+                                      name="hosts_updated", detail=str(e))
                 if os.environ.get("HVDTPU_ELASTIC") == "1":
                     from ..runner.launch import RESTART_EXIT_CODE
                     log.info(

@@ -15,7 +15,7 @@ counters/gauges/histograms into one process-wide
 Stdlib-only by design; importing this package never imports jax.
 """
 
-from . import export, server  # noqa: F401
+from . import export, flightrec, server, slo, trace  # noqa: F401
 from .registry import (  # noqa: F401
     Counter,
     DEFAULT_TIME_BUCKETS,

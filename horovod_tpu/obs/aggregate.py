@@ -121,6 +121,18 @@ def merge_snapshots(rank_snaps: list) -> list:
     cluster series when every rank agrees on the edges.  Synthetic
     ``horovod_tpu_cluster_*`` gauges describe the aggregation itself
     (world size, ranks reporting, per-rank uptime/snapshot age).
+
+    **Staleness:** a rank whose snapshot age exceeds 2x its publish
+    interval is a rank that stopped publishing (crash, shrink, wedge).
+    Its per-rank series still appear (the last known state is postmortem
+    signal), but the synthetic uptime/age gauges carry ``stale="true"``,
+    it is EXCLUDED from the cluster-summed counter and bucket-merged
+    histogram series, and it no longer counts toward
+    ``ranks_reporting`` — a dead rank's frozen snapshot must not keep
+    padding cluster totals and masking the stragglers among the live
+    ranks.  (The aggregator's fetch path separately hard-drops snapshots
+    older than 4x/10s; this covers the 2x–4x window and aggregations fed
+    directly, e.g. tests and the smoke job.)
     """
     fams: dict[str, dict] = {}
     order: list[str] = []
@@ -131,21 +143,35 @@ def merge_snapshots(rank_snaps: list) -> list:
         "world size the aggregator expected this scrape")
     g_reporting = meta_reg.gauge(
         "horovod_tpu_cluster_ranks_reporting",
-        "ranks whose snapshot was present and parseable")
+        "ranks whose snapshot was present, parseable and fresh "
+        "(within 2x the publish interval)")
+    g_stale = meta_reg.gauge(
+        "horovod_tpu_cluster_ranks_stale",
+        "ranks whose last snapshot outlived 2x its publish interval "
+        "(crashed or wedged; excluded from cluster sums)")
     g_uptime = meta_reg.gauge(
         "horovod_tpu_rank_uptime_seconds",
-        "per-rank process uptime at snapshot time", ("rank",))
+        "per-rank process uptime at snapshot time", ("rank", "stale"))
     g_age = meta_reg.gauge(
         "horovod_tpu_rank_snapshot_age_seconds",
-        "per-rank staleness of the aggregated snapshot", ("rank",))
+        "per-rank staleness of the aggregated snapshot",
+        ("rank", "stale"))
 
     size = 0
+    n_stale = 0
     for snap in rank_snaps:
         r = str(snap["rank"])
         size = max(size, int(snap.get("size", 0)))
-        g_uptime.labels(rank=r).set(float(snap.get("uptime_s", 0.0)))
-        if snap.get("time"):
-            g_age.labels(rank=r).set(max(0.0, now - float(snap["time"])))
+        age = (max(0.0, now - float(snap["time"]))
+               if snap.get("time") else 0.0)
+        interval = float(snap.get("interval_s",
+                                  DEFAULT_PUBLISH_INTERVAL_S))
+        stale = age > 2 * interval
+        n_stale += stale
+        st = "true" if stale else "false"
+        g_uptime.labels(rank=r, stale=st).set(
+            float(snap.get("uptime_s", 0.0)))
+        g_age.labels(rank=r, stale=st).set(age)
         for fam in snap["snapshot"]:
             name = fam["name"]
             merged = fams.get(name)
@@ -177,13 +203,17 @@ def merge_snapshots(rank_snaps: list) -> list:
                 if fam["type"] == "counter":
                     merged["samples"].append(
                         {"labels": labels, "value": s["value"]})
-                    merged["_totals"][key] = \
-                        merged["_totals"].get(key, 0.0) + float(s["value"])
+                    if not stale:    # dead ranks don't pad cluster sums
+                        merged["_totals"][key] = \
+                            merged["_totals"].get(key, 0.0) + \
+                            float(s["value"])
                 elif fam["type"] == "histogram":
                     buckets = [(_num(le), c) for le, c in s["buckets"]]
                     merged["samples"].append(
                         {"labels": labels, "buckets": buckets,
                          "sum": s["sum"], "count": s["count"]})
+                    if stale:        # per-rank series only
+                        continue
                     edges = tuple(le for le, _ in buckets)
                     acc = merged["_hist"].get(key)
                     if acc is None:
@@ -221,7 +251,8 @@ def merge_snapshots(rank_snaps: list) -> list:
                     "labelnames": fam["labelnames"], "samples": samples})
 
     g_size.set(float(size or len(rank_snaps)))
-    g_reporting.set(float(len(rank_snaps)))
+    g_reporting.set(float(len(rank_snaps) - n_stale))
+    g_stale.set(float(n_stale))
     out.extend(meta_reg.snapshot())
     return sorted(out, key=lambda f: f["name"])
 

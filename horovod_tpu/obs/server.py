@@ -18,6 +18,7 @@ Binds all interfaces by default (a scrape endpoint); pass
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -46,6 +47,23 @@ def set_cluster_provider(fn) -> None:
         _cluster_provider = fn
 
 
+_health_provider = None
+_health_lock = threading.Lock()
+
+
+def set_health_provider(fn) -> None:
+    """Register (or clear) the callable behind ``GET /healthz``.
+
+    ``fn()`` returns a dict; its ``ready`` key decides 200 vs 503.
+    ``hvd.init()`` arms it and ``shutdown()`` clears it, so the window
+    an elastic re-rendezvous holds the runtime down answers 503 — the
+    router probe contract (ROADMAP 4): an unready replica drops out of
+    rotation instead of eating requests it cannot serve."""
+    global _health_provider
+    with _health_lock:
+        _health_provider = fn
+
+
 def _make_handler(registry: MetricRegistry):
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - http.server API
@@ -56,6 +74,27 @@ def _make_handler(registry: MetricRegistry):
             elif path == "/metrics.json":
                 body = export.to_json(registry.snapshot())
                 ctype = "application/json"
+            elif path == "/healthz":
+                with _health_lock:
+                    provider = _health_provider
+                if provider is None:
+                    health = {"ready": False, "status": "unready",
+                              "reason": "runtime not initialized (or "
+                                        "mid elastic re-rendezvous)"}
+                else:
+                    try:
+                        health = dict(provider())
+                    except Exception as e:  # probe must answer, not 500
+                        health = {"ready": False, "status": "unready",
+                                  "reason": f"health provider failed: {e}"}
+                code = 200 if health.get("ready") else 503
+                payload = json.dumps(health).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                return
             elif path in ("/cluster", "/cluster.json"):
                 with _cluster_lock:
                     provider = _cluster_provider
@@ -74,8 +113,8 @@ def _make_handler(registry: MetricRegistry):
                     ctype = "application/json"
             else:
                 self.send_error(
-                    404, "try /metrics, /metrics.json, /cluster or "
-                         "/cluster.json")
+                    404, "try /metrics, /metrics.json, /cluster, "
+                         "/cluster.json or /healthz")
                 return
             payload = body.encode("utf-8")
             self.send_response(200)

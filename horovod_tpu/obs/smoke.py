@@ -3,14 +3,18 @@
 Two self-contained passes:
 
 1. **Process pass** — register metrics of all three kinds, generate
-   traffic, start the HTTP endpoint (env port or ephemeral), scrape both
-   formats, and validate the Prometheus text with the same
-   :func:`horovod_tpu.obs.export.validate_prometheus` the unit tests use.
+   traffic, run one sampled request trace and one SLO evaluation, start
+   the HTTP endpoint (env port or ephemeral), scrape both formats plus
+   ``/healthz`` (ready AND unready answers), and validate the Prometheus
+   text with the same :func:`horovod_tpu.obs.export.validate_prometheus`
+   the unit tests use.
 2. **Cluster pass** — start the native KV store, spawn two real worker
    processes that each publish a rank-tagged registry snapshot
-   (``--worker <rank>`` re-entry), aggregate them, serve the merged view
+   (``--worker <rank>`` re-entry) carrying a sampled trace's counters
+   and an SLO engine's gauges, aggregate them, serve the merged view
    at ``/cluster``, scrape it, and validate: per-rank ``rank``-labeled
-   series from both ranks, cluster-summed counters, valid exposition.
+   series from both ranks, cluster-summed counters, SLO attainment and
+   trace series from both ranks, valid exposition.
 
 Exit code 0 = the telemetry plane works end to end, single- and
 multi-process.
@@ -23,10 +27,19 @@ import os
 import secrets
 import subprocess
 import sys
+import urllib.error
 import urllib.request
 
-from . import export, server
+from . import export, server, slo, trace
 from .registry import REGISTRY, MetricRegistry
+
+
+def _healthz(base: str):
+    try:
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
 
 
 def _process_pass() -> int:
@@ -38,6 +51,31 @@ def _process_pass() -> int:
     h = reg.histogram("smoke_latency_seconds", "smoke histogram")
     for v in (1e-4, 3e-3, 0.2):
         h.observe(v)
+
+    # One sampled trace: connected span chain, shared id, exportable.
+    tr = trace.Tracer(sample_rate=1.0)
+    root = tr.start_trace("smoke.request", lane="req0")
+    q = root.child("QUEUE")
+    q.end()
+    root.child("PREFILL", after=q).end()
+    root.end(outcome="finished")
+    exp = tr.export()
+    if exp is None or {s["trace_id"] for s in exp["spans"]} \
+            != {exp["trace_id"]}:
+        print(f"obs smoke FAILED: trace export broken: {exp}",
+              file=sys.stderr)
+        return 1
+
+    # One SLO evaluation against the same registry: the gauges must ride
+    # the exposition the endpoint serves.
+    eng = slo.SLOEngine(registry=reg, tick_s=3600)
+    eng.add("p99(smoke_latency_seconds) < 1s over 5m", name="smoke")
+    eng.tick()
+    out = eng.evaluate()
+    if not out["smoke"]["met"]:
+        print(f"obs smoke FAILED: SLO unexpectedly violated: {out}",
+              file=sys.stderr)
+        return 1
 
     port = 0
     for var in server._ENV_VARS:
@@ -52,7 +90,10 @@ def _process_pass() -> int:
         export.validate_prometheus(text)
         for needle in ('smoke_events_total{kind="request"} 3',
                        "smoke_queue_depth 2",
-                       "smoke_latency_seconds_count 3"):
+                       "smoke_latency_seconds_count 3",
+                       'hvd_slo_attainment{slo="smoke"} 1',
+                       'hvd_slo_burn_rate{slo="smoke",window="5m"}',
+                       'hvd_slo_objective{slo="smoke"} 0.99'):
             if needle not in text:
                 print(f"obs smoke FAILED: {needle!r} missing from "
                       f"exposition:\n{text}", file=sys.stderr)
@@ -60,14 +101,36 @@ def _process_pass() -> int:
         blob = json.loads(urllib.request.urlopen(
             f"{base}/metrics.json", timeout=10).read().decode())
         names = {m["name"] for m in blob["metrics"]}
-        if not {"smoke_events_total", "smoke_latency_seconds"} <= names:
+        if not {"smoke_events_total", "smoke_latency_seconds",
+                "hvd_slo_attainment"} <= names:
             print(f"obs smoke FAILED: JSON exposition missing families "
                   f"({names})", file=sys.stderr)
             return 1
+        # /healthz: 503 without a provider (the re-rendezvous window),
+        # 200 once armed, 503 again when cleared.
+        saved = server._health_provider
+        try:
+            server.set_health_provider(None)
+            code, body = _healthz(base)
+            if code != 503 or body.get("ready"):
+                print(f"obs smoke FAILED: unarmed /healthz answered "
+                      f"{code} {body}", file=sys.stderr)
+                return 1
+            server.set_health_provider(
+                lambda: {"ready": True, "status": "ok",
+                         "rank": 0, "size": 1})
+            code, body = _healthz(base)
+            if code != 200 or not body.get("ready"):
+                print(f"obs smoke FAILED: armed /healthz answered "
+                      f"{code} {body}", file=sys.stderr)
+                return 1
+        finally:
+            server.set_health_provider(saved)
     finally:
         srv.close()
     print(f"obs smoke OK: scraped :{srv.port}/metrics "
-          f"({len(text.splitlines())} lines, exposition valid)")
+          f"({len(text.splitlines())} lines, exposition valid; trace "
+          f"chain + SLO gauges + /healthz 200/503 verified)")
     return 0
 
 
@@ -84,6 +147,19 @@ def _worker(rank: int) -> int:
     h = REGISTRY.histogram("smoke_cluster_latency_seconds",
                            "per-rank latency", buckets=(0.01, 0.1, 1.0))
     h.observe(0.05 * (rank + 1))
+    # One sampled trace (counters land in the published registry) and
+    # one SLO evaluation (gauges ditto): /cluster must carry both.
+    sp = trace.TRACER.start_trace("smoke.req", lane=f"req{rank}")
+    sp.child("QUEUE").end()
+    sp.end()
+    if trace.TRACER.export() is None:
+        return 1
+    eng = slo.SLOEngine(tick_s=3600)
+    eng.add("p99(smoke_cluster_latency_seconds) < 2s over 5m",
+            name="smoke")
+    eng.tick()
+    if not eng.evaluate()["smoke"]["met"]:
+        return 1
     pub = aggregate.RankPublisher(rank, 2, interval_s=3600)
     ok = pub.publish_now()
     pub.stop(retract=False)   # the parent aggregates after we exit
@@ -126,11 +202,32 @@ def _cluster_pass() -> int:
                        "smoke_cluster_events_total 3",   # cluster sum
                        'smoke_cluster_depth{rank="1"} 10',
                        "smoke_cluster_latency_seconds_count 2",
-                       "horovod_tpu_cluster_ranks_reporting 2"):
+                       "horovod_tpu_cluster_ranks_reporting 2",
+                       # SLO gauges + trace counters from BOTH workers
+                       # ride the same snapshot path (the router/
+                       # autoscaler single-scrape contract).
+                       'hvd_slo_attainment{rank="0",slo="smoke"} 1',
+                       'hvd_slo_attainment{rank="1",slo="smoke"} 1',
+                       'hvd_traces_total{rank="0",sampled="true"} 1',
+                       'hvd_traces_total{rank="1",sampled="true"} 1',
+                       'hvd_traces_total{sampled="true"} 2'):
             if needle not in text:
                 print(f"obs smoke FAILED: {needle!r} missing from "
                       f"/cluster exposition:\n{text}", file=sys.stderr)
                 return 1
+        # /healthz next to /cluster on the same endpoint.
+        saved = server._health_provider
+        try:
+            server.set_health_provider(
+                lambda: {"ready": True, "status": "ok",
+                         "rank": 0, "size": 2})
+            code, body = _healthz(f"http://127.0.0.1:{srv.port}")
+        finally:
+            server.set_health_provider(saved)
+        if code != 200 or not body.get("ready"):
+            print(f"obs smoke FAILED: /healthz answered {code} {body}",
+                  file=sys.stderr)
+            return 1
         blob = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{srv.port}/cluster.json", timeout=10
         ).read().decode())
@@ -146,7 +243,8 @@ def _cluster_pass() -> int:
             srv.close()
         kv_srv.stop()
     print("obs smoke OK: /cluster aggregated 2 worker processes "
-          "(rank-labeled + summed series, exposition valid)")
+          "(rank-labeled + summed series incl. SLO attainment + trace "
+          "counters, /healthz ready, exposition valid)")
     return 0
 
 

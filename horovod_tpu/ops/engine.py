@@ -39,6 +39,8 @@ from typing import Any, Callable, Optional, Sequence
 from . import collectives as C
 from . import reduction as _R
 from ..obs import REGISTRY as _obs
+from ..obs import flightrec as _frec
+from ..obs import trace as _trace
 from ..utils import logging as hvd_logging
 
 log = hvd_logging.get_logger()
@@ -305,6 +307,7 @@ class CollectiveEngine:
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._cycle_count = 0
+        self._last_cycle_ts = time.monotonic()
         self._last_stall_warn = 0.0
         self._autotuner = None  # attached lazily when autotune is enabled
         self._join_requested = False
@@ -384,6 +387,13 @@ class CollectiveEngine:
                 return handle
             self._names_pending.add(entry.name)
             self._queue.append((entry, handle))
+            # Request-scoped tracing: when the enqueueing context works
+            # a traced request (serving prefill under span.use()), the
+            # collective joins that request's causal chain.
+            sp = _trace.current_span()
+            if sp is not None:
+                sp.event("collective.enqueue", tensor=entry.name,
+                         verb=entry.verb)
             tl = self._state.timeline
             if tl is not None and tl.enabled:
                 # † NEGOTIATING/QUEUE phases: QUEUE = enqueue -> cycle
@@ -431,14 +441,42 @@ class CollectiveEngine:
                     self._tl_close(entry)
                     handle._complete(error=err)
                 log.error("engine stopped by stall shutdown: %s", err)
+                # Postmortem bundle: the ring + registry + the
+                # coordinator's straggler attribution (missing-rank
+                # bitmap per stalled tensor) — the scrape you can no
+                # longer take, written to disk instead.
+                _frec.RECORDER.record("stall_shutdown", error=str(err))
+                _frec.RECORDER.maybe_dump(
+                    "stall_shutdown",
+                    stall=getattr(self._negotiator,
+                                  "last_stall_info", None),
+                    extra={"error": str(err),
+                           "pending": [e.name for e, _ in pending]})
                 return
 
     @property
     def distributed(self) -> bool:
         return self._negotiator.always_check_in
 
+    # -- health (the /healthz readiness probe reads these) ------------------
+    @property
+    def alive(self) -> bool:
+        """Cycle thread running — the readiness half of ``/healthz``."""
+        return bool(self._running and self._thread is not None
+                    and self._thread.is_alive())
+
+    @property
+    def last_negotiation_age_s(self) -> float:
+        """Seconds since the last completed negotiation (multi-process)
+        or engine cycle (single-controller) — a growing age on a rank
+        whose peers are advancing is the wedged-rank probe signal."""
+        ts = getattr(self._negotiator, "last_negotiate_ts", None)
+        return time.monotonic() - (ts if ts is not None
+                                   else self._last_cycle_ts)
+
     def _run_cycle(self, batch: list[tuple[TensorTableEntry, Handle]]) -> None:
         self._cycle_count += 1
+        self._last_cycle_ts = time.monotonic()
         tl = self._state.timeline
         if tl is not None:
             tl.mark_cycle()
@@ -487,6 +525,15 @@ class CollectiveEngine:
                 self._join_event.set()
             log.error("negotiation failed; %d collectives errored: %s",
                       len(batch), err)
+            # Round abort (controller died / peer stall-shut-down first):
+            # same postmortem contract as a local stall shutdown, so the
+            # victim ranks leave bundles naming the withheld tensors too.
+            _frec.RECORDER.record("round_abort", error=str(err))
+            _frec.RECORDER.maybe_dump(
+                "round_abort",
+                stall=getattr(self._negotiator, "last_stall_info", None),
+                extra={"error": str(err),
+                       "entries": [e.name for e, _ in batch]})
             return
         by_name = {e.name: e for e in entries}
         ready: list[TensorTableEntry] = []
@@ -740,6 +787,10 @@ class CollectiveEngine:
                     e.tl_phase = ""
             if group[0].verb == "allreduce":
                 _m_fusion_batch.observe(len(group))
+            _frec.RECORDER.record(
+                "dispatch", name=label, verb=group[0].verb,
+                tensors=len(group),
+                bytes=sum(self._entry_bytes(e) for e in group))
             for e, r in zip(group, results):
                 _m_coll_v[e.verb].inc()
                 _m_bytes_v[e.verb].inc(self._entry_bytes(e))
@@ -749,6 +800,9 @@ class CollectiveEngine:
         except BaseException as err:
             # † error Response delivered to every participating rank so all
             # raise rather than some hanging.
+            _frec.RECORDER.record(
+                "collective_error", name=group[0].name,
+                verb=group[0].verb, error=repr(err))
             for e in group:
                 # .get fallback: an unknown verb reaches this loop via the
                 # _dispatch ValueError, and the error path must never throw.
@@ -829,6 +883,7 @@ class CollectiveEngine:
                 return (f"{n} ({age:.0f}s; {attr})" if attr
                         else f"{n} ({age:.0f}s)")
             desc = ", ".join(_desc(n, age) for n, age in stalled)
+            _frec.RECORDER.record("stall_warning", desc=desc)
             log.warning(
                 "Stall detected: collectives pending > %.0fs without "
                 "completing negotiation: %s. One or more ranks may have "

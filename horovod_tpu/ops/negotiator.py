@@ -57,6 +57,9 @@ class DistributedNegotiator(Negotiator):
         # (so resolution can zero exactly what was raised).
         self._straggling: dict[str, set] = {}
         self.last_stall_info: dict = {}
+        # Freshness stamp for the /healthz readiness probe: age of the
+        # last negotiation round this rank completed.
+        self.last_negotiate_ts: float = time.monotonic()
 
     def negotiate(self, entries: list[TensorTableEntry], *,
                   joined: bool = False) -> NegotiationOutcome:
@@ -75,7 +78,8 @@ class DistributedNegotiator(Negotiator):
             pairs.append((e.name, e.meta(), members))
         t0 = time.monotonic()
         res = self._client.negotiate(pairs, joined=joined)
-        _m_neg_wait.observe(time.monotonic() - t0)
+        self.last_negotiate_ts = time.monotonic()
+        _m_neg_wait.observe(self.last_negotiate_ts - t0)
         self._account_stalls(res)
         # Ready order comes from the coordinator; the engine maps names to
         # local entries (or join zero-participation for names it lacks).

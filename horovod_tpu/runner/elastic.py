@@ -23,6 +23,7 @@ from typing import Callable, List, Optional, Sequence
 
 from .hosts import HostSlots, assign_ranks, parse_hosts
 from ..obs import REGISTRY as _obs
+from ..obs import flightrec as _frec
 from ..utils import logging as hvd_logging
 
 log = hvd_logging.get_logger()
@@ -114,6 +115,7 @@ class ElasticDriver:
         with self._lock:
             self._blacklist.add(hostname)
         _m_worker_failures.inc()
+        _frec.RECORDER.record("elastic_blacklist", name=hostname)
         log.warning("elastic: blacklisted host %s", hostname)
 
     def blacklisted(self) -> set[str]:
@@ -268,6 +270,8 @@ class ElasticDriver:
             _m_rendezvous_rounds.inc()
             _m_hosts.set(len(hosts))
             _m_epoch.set(epoch)
+            _frec.RECORDER.record("elastic_launch", epoch=epoch,
+                                  hosts=len(hosts), restarts=restarts)
             log.info("elastic: launching on %s (epoch %d)", hosts, epoch)
             env = dict(extra_env or {})
             env["HVDTPU_ELASTIC"] = "1"

@@ -78,10 +78,15 @@ class ServingSession:
     """One live engine + its request-facing bookkeeping."""
 
     def __init__(self, engine: ServingEngine, *,
-                 timeline: Optional[Timeline] = None) -> None:
+                 timeline: Optional[Timeline] = None,
+                 own_timeline: bool = True) -> None:
         self.engine = engine
+        # own_timeline=False: the timeline is borrowed (the runtime's
+        # global Timeline v2) and must survive this session's close().
+        self._own_timeline = own_timeline
         self._timeline = timeline or Timeline(None)
         self._futures: dict[int, Future] = {}
+        self._trace_ids: dict[int, str] = {}       # req_id -> trace id
         self._t_last_emit: dict[int, float] = {}   # req_id -> last token ts
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
@@ -101,8 +106,24 @@ class ServingSession:
                                      eos_token=eos_token,
                                      stream_cb=stream_cb)
             self._futures[req.req_id] = fut
-        self._timeline.start_activity(f"req{req.req_id}", "QUEUE")
+            if req.trace.sampled:
+                self._trace_ids[req.req_id] = req.trace.trace_id
+                # Bounded like the tracer's finished-trace table: once a
+                # trace would be evicted there, its id here is dead
+                # weight — don't leak one entry per request forever.
+                from ..obs import trace as _trace
+                while len(self._trace_ids) > _trace.TRACER.keep:
+                    self._trace_ids.pop(next(iter(self._trace_ids)))
         return fut
+
+    def request_trace(self, req_id: int) -> Optional[dict]:
+        """The finished request's trace as a JSON-ready dict (span chain
+        with shared trace id), or None when the request was unsampled or
+        its trace already evicted from the tracer's bounded table."""
+        from ..obs import trace as _trace
+        with self._lock:
+            tid = self._trace_ids.get(req_id)
+        return _trace.TRACER.export(tid) if tid else None
 
     def drain(self, max_steps: Optional[int] = None) -> None:
         """Synchronously step the engine until every request finished."""
@@ -148,7 +169,8 @@ class ServingSession:
             self._stop.set()
             self._thread.join()
             self._thread = None
-        self._timeline.close()
+        if self._own_timeline:
+            self._timeline.close()
 
     def __enter__(self) -> "ServingSession":
         return self
@@ -162,7 +184,6 @@ class ServingSession:
             emissions = self.engine.step()
             failed = self.engine.pop_failed()
         for req, exc in failed:
-            self._timeline.end_activity(f"req{req.req_id}")
             self._t_last_emit.pop(req.req_id, None)
             _m_requests.labels(outcome="failed").inc()
             fut = self._futures.pop(req.req_id, None)
@@ -170,12 +191,9 @@ class ServingSession:
                 fut.set_exception(exc)
         now = time.monotonic()
         for req, token in emissions:
-            name = f"req{req.req_id}"
             if req.t_first_token is None:
                 req.t_first_token = now
                 _m_ttft.observe(now - req.t_submit)
-                self._timeline.end_activity(name)          # QUEUE/PREFILL
-                self._timeline.start_activity(name, "DECODE")
             else:
                 last = self._t_last_emit.get(req.req_id)
                 if last is not None:
@@ -187,8 +205,6 @@ class ServingSession:
                 self._resolve(req)
 
     def _resolve(self, req: Request) -> None:
-        name = f"req{req.req_id}"
-        self._timeline.end_activity(name)
         self._t_last_emit.pop(req.req_id, None)
         m = req.metrics()
         # Registry routing of the per-request metrics dict (the log line
@@ -202,11 +218,12 @@ class ServingSession:
             _m_decode_rate.set(m["decode_tokens_per_s"])
         log.info(
             "serving req=%d prompt=%d new=%d queue_wait=%.4fs ttft=%.4fs "
-            "decode_tok_s=%s preemptions=%d",
+            "decode_tok_s=%s preemptions=%d trace=%s",
             m["req_id"], m["prompt_len"], m["new_tokens"],
             m["queue_wait_s"] or 0.0, m["ttft_s"] or 0.0,
             f"{m['decode_tokens_per_s']:.1f}"
-            if m["decode_tokens_per_s"] else "n/a", m["preemptions"])
+            if m["decode_tokens_per_s"] else "n/a", m["preemptions"],
+            m["trace_id"] or "-")
         fut = self._futures.pop(req.req_id, None)
         if fut is not None and not fut.done():
             fut.set_result(RequestResult(
@@ -231,5 +248,20 @@ def serve(params: Any, cfg, *, mesh=None,
     base = engine_cfg or EngineConfig()
     if engine_kw:
         base = dataclasses.replace(base, **engine_kw)
-    engine = ServingEngine(params, cfg, engine_cfg=base, mesh=mesh)
-    return ServingSession(engine, timeline=timeline)
+    own_timeline = True
+    if timeline is None:
+        # Request traces render into the runtime's Timeline v2 when one
+        # is armed (HVDTPU_TIMELINE / hvd.start_timeline): one Perfetto
+        # load then shows the request chains next to the engine's
+        # collective spans.  Borrowed, so session.close() must not close
+        # the runtime's writer.
+        from ..context import global_state, is_initialized
+        if is_initialized():
+            state_tl = global_state().timeline
+            if state_tl is not None and state_tl.enabled:
+                timeline = state_tl
+                own_timeline = False
+    engine = ServingEngine(params, cfg, engine_cfg=base, mesh=mesh,
+                           timeline=timeline)
+    return ServingSession(engine, timeline=timeline,
+                          own_timeline=own_timeline)

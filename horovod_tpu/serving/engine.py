@@ -32,9 +32,10 @@ import numpy as np
 
 from ..models import llama
 from ..obs import REGISTRY as _obs
+from ..obs import trace as _trace
 from ..utils import logging as hvd_logging
 from .kv_pager import KVPager, PagedKVCache
-from .scheduler import Request, Scheduler
+from .scheduler import Request, RequestState, Scheduler
 
 log = hvd_logging.get_logger()
 
@@ -94,7 +95,11 @@ class ServingEngine:
 
     def __init__(self, params: Any, cfg: llama.LlamaConfig, *,
                  engine_cfg: EngineConfig = EngineConfig(),
-                 mesh=None) -> None:
+                 mesh=None, timeline=None) -> None:
+        #: Timeline-v2 sink request traces render on (one lane per
+        #: request with QUEUE->PREFILL->DECODE flow arrows); None keeps
+        #: traces JSON/flight-recorder-only.
+        self.timeline = timeline
         if cfg.use_moe:
             raise NotImplementedError("serving does not support MoE configs")
         self.params = params
@@ -210,6 +215,13 @@ class ServingEngine:
                       max_new_tokens=max_new_tokens, eos_token=eos_token,
                       stream_cb=stream_cb)
         self._next_id += 1
+        # Admission is the root of the request's causal chain: one trace
+        # id covers every phase span from here to the terminal state
+        # (obs/trace decides sampling; unsampled requests ride NULL_SPAN).
+        req.trace = _trace.TRACER.start_trace(
+            "serving.request", lane=f"req{req.req_id}",
+            timeline=self.timeline, req_id=req.req_id,
+            prompt_len=int(prompt.size), max_new_tokens=max_new_tokens)
         self.scheduler.submit(req)
         return req
 
@@ -288,21 +300,32 @@ class ServingEngine:
         toks = req.prefill_tokens
         P = int(toks.shape[0])
         Pb = self._bucket_len(P)
-        padded = np.zeros((1, Pb), np.int32)
-        padded[0, :P] = toks
-        tok, ks, vs = self._prefill(
-            self.params, jnp.asarray(padded),
-            jnp.asarray([P - 1], jnp.int32))
-        blocks = self.pager.table(req.req_id)
-        nb = self.cache.blocks_for(P)
-        # Only the blocks the P real positions span are written; the +1
-        # slot block (allocated for the emitted token) is untouched here.
-        lim = min(Pb, nb * self.cache.block_size)
-        ks, vs = ks[:, :, :lim], vs[:, :, :lim]
-        self.k_pool, self.v_pool = self._scatter(
-            self.k_pool, self.v_pool, ks, vs,
-            jnp.asarray(blocks[:nb], jnp.int32))
-        return self._emit(req, int(tok[0]))
+        sp = req.open_phase("prefill", tokens=P, bucket=Pb)
+        # The span is the context's current span while the prefill
+        # dispatches, so nested layers (collectives the model enqueues)
+        # attach their events to this request's chain.
+        with sp.use():
+            padded = np.zeros((1, Pb), np.int32)
+            padded[0, :P] = toks
+            tok, ks, vs = self._prefill(
+                self.params, jnp.asarray(padded),
+                jnp.asarray([P - 1], jnp.int32))
+            blocks = self.pager.table(req.req_id)
+            nb = self.cache.blocks_for(P)
+            # Only the blocks the P real positions span are written; the
+            # +1 slot block (for the emitted token) is untouched here.
+            lim = min(Pb, nb * self.cache.block_size)
+            ks, vs = ks[:, :, :lim], vs[:, :, :lim]
+            self.k_pool, self.v_pool = self._scatter(
+                self.k_pool, self.v_pool, ks, vs,
+                jnp.asarray(blocks[:nb], jnp.int32))
+        req.close_phase("prefill")
+        token = self._emit(req, int(tok[0]))
+        if req.state == RequestState.RUNNING:
+            # The decode phase opens once and spans every tick until the
+            # terminal state (scheduler.finish/preempt closes it).
+            req.open_phase("decode")
+        return token
 
     def _decode_tick(self) -> list[tuple[Request, int]]:
         jnp = self._jnp

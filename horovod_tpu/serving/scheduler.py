@@ -34,6 +34,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..obs import REGISTRY as _obs
+from ..obs import trace as _trace
 from .kv_pager import KVPager, OutOfBlocks
 
 _m_preemptions = _obs.counter(
@@ -70,6 +71,19 @@ class Request:
     t_admitted: Optional[float] = None
     t_first_token: Optional[float] = None
     t_finished: Optional[float] = None
+    #: last time the request (re-)entered the waiting queue — t_submit
+    #: at first submit, the preemption time afterwards; the trace's
+    #: per-QUEUE-span wait is measured from here (t_submit would charge
+    #: a preempted request's whole prior lifetime to queueing).
+    t_enqueued: Optional[float] = None
+    #: request-scoped trace (obs/trace): the root span of this request's
+    #: causal chain (NULL_SPAN when unsampled/untraced) plus the open
+    #: phase spans, keyed "queue"/"prefill"/"decode"; "prev" holds the
+    #: last ended phase span so the next phase chains a flow arrow to it.
+    trace: object = dataclasses.field(
+        default=_trace.NULL_SPAN, compare=False, repr=False)
+    spans: dict = dataclasses.field(
+        default_factory=dict, compare=False, repr=False)
 
     @property
     def remaining_new(self) -> int:
@@ -91,7 +105,30 @@ class Request:
                 (len(self.generated) - 1) / decode_s
                 if decode_s and len(self.generated) > 1 else None),
             "preemptions": self.preemptions,
+            "trace_id": self.trace.trace_id,
         }
+
+    # -- trace phases (one connected QUEUE->PREFILL->DECODE chain) -------
+    def open_phase(self, name: str, **attrs) -> object:
+        """Open a phase span chained (flow arrow) to the previously
+        ended one; no-ops end-to-end on unsampled requests."""
+        sp = self.trace.child(name.upper(), after=self.spans.get("prev"),
+                              **attrs)
+        self.spans[name] = sp
+        return sp
+
+    def close_phase(self, name: str, **attrs) -> None:
+        sp = self.spans.pop(name, None)
+        if sp is not None:
+            sp.end(**attrs)
+            self.spans["prev"] = sp
+
+    def close_trace(self, outcome: str, **attrs) -> None:
+        """End any open phase and the root span (terminal state)."""
+        for phase in ("queue", "prefill", "decode"):
+            self.close_phase(phase)
+        self.trace.end(outcome=outcome, new_tokens=len(self.generated),
+                       preemptions=self.preemptions, **attrs)
 
 
 class Scheduler:
@@ -125,12 +162,15 @@ class Scheduler:
     def _fail(self, req: Request, why: str) -> None:
         req.state = RequestState.CANCELLED
         req.t_finished = self._clock()
+        req.close_trace("failed", error=why)
         self.failed.append((req, OutOfBlocks(why)))
 
     # -- queue surface ---------------------------------------------------
     def submit(self, req: Request) -> None:
         req.t_submit = req.t_submit or self._clock()
+        req.t_enqueued = req.t_submit
         req.state = RequestState.WAITING
+        req.open_phase("queue", prompt_len=int(req.prompt.shape[0]))
         self.waiting.append(req)
 
     def has_work(self) -> bool:
@@ -142,10 +182,16 @@ class Scheduler:
         req.t_finished = self._clock()
         self.running.remove(req)
         self.pager.release(req.req_id)
+        m = req.metrics()
+        req.close_trace("finished",
+                        ttft_s=m["ttft_s"],
+                        queue_wait_s=round(m["queue_wait_s"], 6),
+                        decode_tokens_per_s=m["decode_tokens_per_s"])
 
     def cancel(self, req: Request) -> None:
         req.state = RequestState.CANCELLED
         req.t_finished = self._clock()
+        req.close_trace("cancelled")
         if req in self.running:
             self.running.remove(req)
             self.pager.release(req.req_id)
@@ -183,6 +229,17 @@ class Scheduler:
             req.context_len = n
             req.state = RequestState.RUNNING
             req.t_admitted = req.t_admitted or self._clock()
+            # Batch decision lands on the trace: which slot of this
+            # step's prefill batch took the request, and what the
+            # admission cost was.
+            req.close_phase(
+                "queue",
+                queue_wait_s=round(
+                    self._clock() - (req.t_enqueued
+                                     if req.t_enqueued is not None
+                                     else req.t_submit), 6),
+                prefill_batch_slot=len(admitted),
+                budget_left=budget - n)
             self.running.append(req)
             admitted.append(req)
             budget -= n
@@ -218,6 +275,15 @@ class Scheduler:
         req.state = RequestState.WAITING
         req.preemptions += 1
         _m_preemptions.inc()
+        # The eviction is part of the request's causal chain: close the
+        # decode phase as preempted and re-enter the queue as a new span
+        # (the chain reads QUEUE->PREFILL->DECODE->QUEUE->...).
+        req.close_phase("decode", preempted=True)
+        req.trace.event("preempt",
+                        generated=len(req.generated),
+                        refill_tokens=int(req.prefill_tokens.shape[0]))
+        req.t_enqueued = self._clock()
+        req.open_phase("queue", preemption=req.preemptions)
         self.waiting.appendleft(req)
 
     def _youngest_other(self, keep: Request) -> Optional[Request]:

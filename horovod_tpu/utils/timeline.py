@@ -187,6 +187,44 @@ class Timeline:
                         "bp": "e", "id": flow_id, "pid": 0,
                         "tid": self._tid(tensor_name), "ts": self._ts_us()})
 
+    def complete(self, lane: str, name: str, t0_mono: float,
+                 t1_mono: float, args: Optional[dict] = None) -> None:
+        """Complete event (``"ph": "X"``): one slice with explicit start
+        and duration, timestamped from ``time.monotonic()`` values.  The
+        request tracer (:mod:`horovod_tpu.obs.trace`) emits each ended
+        span this way — the span's interval is only known at end time,
+        when a B/E pair could no longer be placed retroactively."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._fh is None:
+                return
+            ev = {"name": name, "ph": "X", "pid": 0,
+                  "tid": self._tid(lane),
+                  "ts": (t0_mono - self._start) * 1e6,
+                  "dur": max(0.0, (t1_mono - t0_mono) * 1e6)}
+            if args:
+                ev["args"] = dict(args)
+            self._emit(ev)
+
+    def flow_at(self, lane: str, flow_id: int, ph: str,
+                t_mono: float) -> None:
+        """Flow endpoint (``ph`` = ``"s"`` or ``"f"``) at an explicit
+        monotonic time — the retroactive form of :meth:`flow_start` /
+        :meth:`flow_end`, used to chain already-ended ``X`` slices
+        (QUEUE→PREFILL→DECODE arrows)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._fh is None:
+                return
+            ev = {"name": "hvd.link", "cat": "flow", "ph": ph,
+                  "id": flow_id, "pid": 0, "tid": self._tid(lane),
+                  "ts": (t_mono - self._start) * 1e6}
+            if ph == "f":
+                ev["bp"] = "e"
+            self._emit(ev)
+
     def counter(self, name: str, values: dict) -> None:
         """Counter track sample (``"ph": "C"``): ``values`` is a flat
         ``{series: number}`` dict, rendered by Perfetto as stacked

@@ -3,17 +3,26 @@
 Modes (``HVDTPU_TEST_MODE``):
 
 - ``cluster`` (default, np=2): each rank records rank-distinct metric
-  traffic and publishes its snapshot; rank 0 aggregates via
-  ``hvd.cluster_metrics`` AND over HTTP (``/cluster`` on a live
-  endpoint), asserting both ranks' counters appear rank-labeled, the
-  cluster sum is right, and the exposition validates.
+  traffic, runs an SLO evaluation and a sampled trace, and publishes
+  its snapshot; rank 0 aggregates via ``hvd.cluster_metrics`` AND over
+  HTTP (``/cluster`` on a live endpoint), asserting both ranks'
+  counters appear rank-labeled, the cluster sum is right, SLO gauges
+  and trace counters aggregated from both ranks, ``/healthz`` answers
+  ready, and the exposition validates.
 - ``stall`` (np=4): ranks 0-2 submit an allreduce rank 3 withholds; the
   submitting ranks must see straggler attribution naming rank 3 and the
   tensor — in the shutdown error, and in the
   ``horovod_tpu_straggler{rank,tensor}`` gauge — while rank 3 exits
   cleanly.
+- ``flightrec`` (np=2): rank 0 submits an allreduce rank 1 withholds
+  until stall shutdown; the engine must auto-dump a flight-recorder
+  bundle (dir from ``HVDTPU_FLIGHT_RECORDER_DIR``) whose stall
+  attribution names rank 1 — missing-rank list AND bitmap — next to the
+  event ring and the registry snapshot.
 """
 
+import glob
+import json
 import os
 import sys
 import time
@@ -36,10 +45,70 @@ def _cluster_family(snap, name):
     return None
 
 
+def _serving_trace_e2e() -> None:
+    """Rank 0's acceptance half: one tiny serving request under an armed
+    Timeline v2 must produce one connected trace — QUEUE/PREFILL/DECODE
+    spans sharing a trace id, flow-arrow-chained on the request lane."""
+    import tempfile
+
+    import jax
+
+    from horovod_tpu import serving
+    from horovod_tpu.models import llama
+
+    tl_path = os.path.join(tempfile.mkdtemp(prefix="hvdtpu_obs_"),
+                           "tl_rank0.json")
+    hvd.start_timeline(tl_path)
+    try:
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        with serving.serve(params, cfg, num_blocks=16, block_size=8,
+                           max_active=2) as sess:
+            fut = sess.submit(np.arange(5, dtype=np.int32), max_tokens=4)
+            sess.drain()
+            res = fut.result(timeout=60)
+            tr = sess.request_trace(res.metrics["req_id"])
+    finally:
+        hvd.stop_timeline()
+    assert tr is not None, "request was not traced at sample rate 1.0"
+    names = {s["name"] for s in tr["spans"]}
+    assert {"QUEUE", "PREFILL", "DECODE", "serving.request"} <= names, names
+    assert {s["trace_id"] for s in tr["spans"]} == {tr["trace_id"]}
+    [root] = [s for s in tr["spans"] if s["parent_id"] is None]
+    assert all(s["parent_id"] == root["span_id"] for s in tr["spans"]
+               if s["parent_id"] is not None), tr["spans"]
+    with open(tl_path) as fh:
+        events = json.load(fh)
+    xs = [e for e in events if e.get("ph") == "X"
+          and e.get("args", {}).get("trace_id") == tr["trace_id"]]
+    assert {e["name"] for e in xs} >= {"QUEUE", "PREFILL", "DECODE"}, \
+        [e["name"] for e in xs]
+    links = [e for e in events if e.get("name") == "hvd.link"]
+    assert {e["ph"] for e in links} >= {"s", "f"}, links
+
+
 def cluster_mode(me: int, n: int) -> int:
+    from horovod_tpu.obs import slo, trace
+
     REGISTRY.counter("obs_e2e_events_total", "e2e traffic").inc(me + 1)
     REGISTRY.histogram("obs_e2e_lat_seconds", "e2e latency",
                        buckets=(0.01, 0.1)).observe(0.05)
+    # SLO engine armed at init() from HVDTPU_SLO (set in main); force a
+    # deterministic tick+evaluate so gauges exist before the publish.
+    st = slo.status()
+    assert "e2e" in st and st["e2e"]["met"], st
+    # One sampled trace per rank (hvd_traces_total sums to 2): rank 0
+    # runs the full serving acceptance chain when the launcher asks for
+    # it (HVDTPU_OBS_SERVING_E2E=1 — the slow-marked e2e; the tiny-llama
+    # compile dominates this worker's runtime), a manual span pair
+    # otherwise.
+    if me == 0 and os.environ.get("HVDTPU_OBS_SERVING_E2E") == "1":
+        _serving_trace_e2e()
+    else:
+        sp = trace.start_trace("e2e.ping", lane=f"ping{me}")
+        sp.child("QUEUE").end()
+        sp.end()
+        assert trace.export()["trace_id"] == sp.trace_id
     assert aggregate.publish_now(), "publisher not armed or KV unreachable"
 
     if me == 0:
@@ -86,6 +155,31 @@ def cluster_mode(me: int, n: int) -> int:
         # Per-rank engine series prove real-subsystem metrics aggregate
         # too, not just test-local families.
         assert 'hvd_negotiate_wait_seconds_count{rank="1"}' in text, text
+        # SLO gauges from BOTH ranks ride the same snapshot path (the
+        # autoscaler/router single-scrape contract), traces counted.
+        assert 'hvd_slo_attainment{rank="0",slo="e2e"} 1' in text, text
+        assert 'hvd_slo_attainment{rank="1",slo="e2e"} 1' in text, text
+        assert 'hvd_slo_burn_rate{rank="0",slo="e2e",window="5m"}' \
+            in text, text
+        assert 'hvd_slo_burn_rate{rank="1",slo="e2e",window="1h"}' \
+            in text, text
+        assert 'hvd_traces_total{rank="0",sampled="true"} 1' in text, text
+        assert 'hvd_traces_total{rank="1",sampled="true"} 1' in text, text
+        assert 'hvd_traces_total{sampled="true"} 2' in text, text
+        # /healthz on the same endpoint: ready while the runtime is up.
+        srv2 = server.MetricsServer(0, addr="127.0.0.1")
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv2.port}/healthz",
+                    timeout=10) as resp:
+                assert resp.status == 200, resp.status
+                hz = json.loads(resp.read().decode())
+        finally:
+            srv2.close()
+        assert hz["ready"] is True and hz["status"] == "ok", hz
+        assert hz["rank"] == 0 and hz["size"] == 2, hz
+        assert hz["engine_alive"] is True, hz
+        assert hz["last_negotiation_age_s"] >= 0.0, hz
     hvd.barrier()
     print(f"rank {me}: CLUSTER-OK")
     return 0
@@ -115,11 +209,73 @@ def stall_mode(me: int, n: int) -> int:
     return 0
 
 
+def flightrec_mode(me: int, n: int) -> int:
+    frdir = os.environ["HVDTPU_FLIGHT_RECORDER_DIR"]
+    if me == 0:
+        h = hvd.allreduce_async(
+            hvd.from_local(np.ones((1, 2), np.float32)),
+            name="t.blackbox")
+        try:
+            hvd.synchronize(h)
+        except hvd.HorovodInternalError:
+            pass
+        else:
+            print("rank 0: FAIL no stall error")
+            return 1
+        # The auto-dump runs on the engine's cycle thread; the error
+        # reaches this thread first.  Wait (bounded) for the atomic
+        # os.replace to land.
+        deadline = time.monotonic() + 15.0
+        while True:
+            bundles = sorted(glob.glob(os.path.join(
+                frdir, "flightrec-rank0-*-stall_shutdown-*.json")))
+            if bundles:
+                break
+            assert time.monotonic() < deadline, \
+                f"no auto-dumped bundle in {os.listdir(frdir)}"
+            time.sleep(0.2)
+        with open(bundles[-1]) as fh:
+            b = json.load(fh)
+        assert b["rank"] == 0 and b["size"] == 2, b
+        # Stall attribution names the withholding rank — list AND bitmap.
+        st = b["stall"]
+        assert "t.blackbox" in st, st
+        assert st["t.blackbox"]["missing_ranks"] == [1], st
+        assert st["t.blackbox"]["missing_rank_bitmap"] == 0b10, st
+        assert st["t.blackbox"]["age_ms"] > 0, st
+        # The ring carries the causally-preceding events and the bundle
+        # carries a full registry snapshot next to them.
+        kinds = {e["kind"] for e in b["events"]}
+        assert {"dispatch", "stall_warning", "stall_shutdown"} & kinds, \
+            kinds
+        fams = {f["name"] for f in b["metrics"]}
+        assert "hvd_collectives_total" in fams, fams
+        assert "hvd_flightrec_events_total" in fams, fams
+        print("rank 0: FLIGHTREC-OK")
+        return 0
+    time.sleep(6.0)
+    print(f"rank {me}: FLIGHTREC-BYSTANDER-OK")
+    return 0
+
+
 def main() -> int:
+    mode = os.environ.get("HVDTPU_TEST_MODE", "cluster")
+    if mode == "cluster":
+        # Armed through the real config surface at init(); the threshold
+        # sits past the histogram's last finite edge so the 0.05 sample
+        # counts good and attainment is exactly 1.0 on both ranks.
+        os.environ.setdefault(
+            "HVDTPU_SLO", "e2e=p99(obs_e2e_lat_seconds) < 200ms over 5m")
     hvd.init()
     me, n = hvd.cross_rank(), hvd.cross_size()
-    mode = os.environ.get("HVDTPU_TEST_MODE", "cluster")
-    rc = cluster_mode(me, n) if mode == "cluster" else stall_mode(me, n)
+    if mode == "cluster":
+        rc = cluster_mode(me, n)
+    elif mode == "stall":
+        rc = stall_mode(me, n)
+    elif mode == "flightrec":
+        rc = flightrec_mode(me, n)
+    else:
+        raise SystemExit(f"unknown HVDTPU_TEST_MODE={mode!r}")
     hvd.shutdown()
     return rc
 
