@@ -1,0 +1,320 @@
+"""Chaos scenario harness: ``python -m horovod_tpu.chaos.run``.
+
+Runs the recovery scenarios the CI ``chaos-recovery`` job asserts —
+failures are INPUTS here, recovery is the unit under test:
+
+- **elastic** (np=4, real ``hvdrun``-path subprocesses): workers train
+  a committed :class:`~horovod_tpu.elastic.FileBackedState` loop while
+  ``HVDTPU_FAULTS`` injects one rank death (``dispatch:die`` behind a
+  cross-relaunch once-latch), p=0.02 KV errors on both blob directions,
+  and probabilistic negotiation delays.  Asserts: the ElasticDriver
+  blacklists the dead rank's host and relaunches, every surviving
+  incarnation's per-step allreduce equals its world size (correct
+  results), the job completes within a bounded recovery budget, and a
+  flight-recorder bundle on disk names the injected fault.
+- **serving** (np=1, in-process): a live serving session takes an
+  injected engine-step fault mid-decode; asserts in-flight requests
+  finish with ``finish_reason="error"`` (partial tokens kept),
+  ``/healthz`` transitions 200 → 503 (the drain window) → 200, and a
+  post-recovery request completes normally.
+- **determinism**: the same seeded spec driven over the same traversal
+  schedule twice produces the bit-identical fault sequence and
+  ``hvd_faults_injected_total`` deltas — the property that makes every
+  other scenario reproducible.
+
+Exit 0 iff every selected scenario passes.  ``--worker`` is the
+internal np=4 worker entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+#: generous wall-clock bound on the whole np=4 kill/blacklist/relaunch
+#: circle — "recovery time is bounded" is an acceptance criterion, and
+#: an unbounded hang must fail the job, not outwait CI.
+ELASTIC_BUDGET_S = 240.0
+
+_WORKER_TOTAL_STEPS = 10
+
+
+# ---------------------------------------------------------------------------
+# np=4 worker (internal entry point)
+# ---------------------------------------------------------------------------
+
+def worker_main() -> int:
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import horovod_tpu as hvd
+    import horovod_tpu.elastic as hvd_elastic
+    from horovod_tpu.elastic import FileBackedState
+
+    state_path = os.environ["HVDTPU_CHAOS_STATE"]
+    log_path = os.environ["HVDTPU_CHAOS_LOG"]
+    total = int(os.environ.get("HVDTPU_CHAOS_TOTAL",
+                               str(_WORKER_TOTAL_STEPS)))
+
+    def log_line(text: str) -> None:
+        with open(log_path, "a") as f:
+            f.write(text + "\n")
+
+    hvd.init()
+    me, n = hvd.rank(), hvd.size()
+    log_line(f"START rank={me} size={n}")
+    # NB: construction broadcasts rank 0's loaded state (4 engine
+    # dispatches), so the injected death's after=N counts those too.
+    state = FileBackedState(state_path, step=0)
+    log_line(f"RESUME rank={me} size={n} resume_step={state.step}")
+
+    @hvd_elastic.run
+    def train(state):
+        for step in range(state.step, total):
+            x = hvd.from_local(np.ones((1, 2), np.float32))
+            out = hvd.to_numpy(hvd.synchronize(
+                hvd.allreduce_async(x, hvd.Sum, name=f"chaos.w.{step}")))
+            # Correctness under injected faults: a sum of ones across
+            # the CURRENT world must equal the world size exactly; a
+            # mesh inconsistency after recovery shows up right here.
+            got = float(np.ravel(out)[0])
+            if got != float(n):
+                log_line(f"BAD rank={me} step={step} got={got} "
+                         f"want={n}")
+                raise SystemExit(3)
+            state.step = step + 1
+            state.commit()
+            log_line(f"STEP rank={me} size={n} step={step}")
+        return state.step
+
+    train(state)
+    log_line(f"DONE rank={me} size={n} step={state.step}")
+    hvd.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# scenario: elastic recovery at np=4
+# ---------------------------------------------------------------------------
+
+def scenario_elastic(np_total: int = 4, verbose: bool = False) -> None:
+    from ..runner.elastic import ElasticDriver, FixedDiscovery
+
+    work = tempfile.mkdtemp(prefix="hvdtpu_chaos_")
+    state_path = os.path.join(work, "state.json")
+    log_path = os.path.join(work, "train.log")
+    frec_dir = os.path.join(work, "flightrec")
+    die_latch = os.path.join(work, "die.latch")
+    per_host = max(1, np_total // 2)
+
+    # after=8: 4 state-sync broadcasts at init + steps 0..2 = traversal
+    # 8 is step 3's allreduce — the death lands mid-training, past
+    # several durable commits.  The once-latch keeps the relaunched
+    # incarnation (same env, fresh rank 1) from dying again.
+    faults = (f"dispatch:rank=1:die:after=8:once={die_latch}; "
+              "kv_put:err:p=0.02:seed=7; kv_get:err:p=0.02:seed=7; "
+              "negotiate:delay=20ms:p=0.05:seed=3")
+    env = {
+        "HVDTPU_FAULTS": faults,
+        "HVDTPU_CHAOS_STATE": state_path,
+        "HVDTPU_CHAOS_LOG": log_path,
+        "HVDTPU_CHAOS_TOTAL": str(_WORKER_TOTAL_STEPS),
+        "HVDTPU_FLIGHT_RECORDER_DIR": frec_dir,
+        "PYTHONPATH": os.pathsep.join(
+            [p for p in (os.getcwd(),
+                         os.environ.get("PYTHONPATH", "")) if p]),
+    }
+    # Two "hosts" (both exec locally) so the dead rank's host is
+    # blacklistable and the job relaunches on the survivor at np//2.
+    driver = ElasticDriver(
+        FixedDiscovery(f"localhost:{per_host},127.0.0.1:{per_host}"),
+        min_np=1, max_np=np_total,
+        # Longer than the scenario: probation/decay has its own unit
+        # tests; here a mid-run re-admission would only add rounds.
+        blacklist_cooldown_s=600.0)
+    cmd = [sys.executable, "-m", "horovod_tpu.chaos.run", "--worker"]
+    t0 = time.monotonic()
+    code = driver.run_job(cmd, extra_env=env, max_restarts=5,
+                          slot_timeout_s=60.0,
+                          launch_kwargs={"verbose": verbose,
+                                         "connectivity_check": False})
+    dt = time.monotonic() - t0
+    assert code == 0, f"elastic chaos job failed with exit code {code}"
+    assert dt < ELASTIC_BUDGET_S, \
+        f"recovery not bounded: took {dt:.0f}s > {ELASTIC_BUDGET_S:.0f}s"
+    assert os.path.exists(die_latch), "injected death never fired"
+
+    lines = open(log_path).read().splitlines()
+    assert not any(ln.startswith("BAD") for ln in lines), \
+        [ln for ln in lines if ln.startswith("BAD")]
+    assert f"START rank=0 size={np_total}" in lines, lines
+    # The relaunch ran on the surviving host at half size, resuming
+    # from a committed step (not from scratch).
+    resumed = [ln for ln in lines
+               if ln.startswith(f"RESUME rank=0 size={per_host} ")]
+    assert resumed, f"no relaunch at np={per_host}:\n" + "\n".join(lines)
+    assert all(int(ln.split("resume_step=")[1]) > 0 for ln in resumed), \
+        resumed
+    assert any(ln.startswith(f"DONE rank=0 size={per_host} "
+                             f"step={_WORKER_TOTAL_STEPS}")
+               for ln in lines), lines
+    assert json.load(open(state_path))["step"] == _WORKER_TOTAL_STEPS
+
+    # The dead rank's black box names the injected fault.
+    bundles = glob.glob(os.path.join(
+        frec_dir, "flightrec-rank1-*-injected_death-*.json"))
+    assert bundles, f"no injected_death bundle in {os.listdir(frec_dir)}"
+    b = json.load(open(bundles[-1]))
+    assert b["extra"]["site"] == "dispatch", b["extra"]
+    assert "die" in b["extra"]["rule"], b["extra"]
+    assert any(e["kind"] == "fault_injected"
+               and e["data"]["fault_kind"] == "die"
+               for e in b["events"]), b["events"][-5:]
+    print(f"CHAOS-ELASTIC-OK np={np_total} rounds="
+          f"{sum(1 for ln in lines if ln.startswith('START rank=0'))} "
+          f"wall={dt:.0f}s")
+
+
+# ---------------------------------------------------------------------------
+# scenario: serving degradation + /healthz transitions (np=1)
+# ---------------------------------------------------------------------------
+
+def _healthz(port: int) -> int:
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def scenario_serving() -> None:
+    import jax
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from . import arm, disarm
+    from .. import serving
+    from ..models import llama
+    from ..obs import server
+
+    hvd.init()
+    srv = server.MetricsServer(0, addr="127.0.0.1")
+    try:
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        sess = serving.serve(params, cfg, num_blocks=16, block_size=8,
+                             max_active=2, recovery_pause_s=0.75)
+        with sess:
+            assert _healthz(srv.port) == 200
+            # Arm + submit BEFORE the loop starts so step 1 admits both
+            # requests and step 2 (the armed traversal) aborts both.
+            arm("serving_step:err:after=2:times=1")
+            futs = [sess.submit(np.arange(4, dtype=np.int32) + r,
+                                max_tokens=8) for r in range(2)]
+            sess.start()
+            # 200 -> 503 (the drain window) ...
+            deadline = time.monotonic() + 30.0
+            saw_503 = False
+            while time.monotonic() < deadline:
+                if _healthz(srv.port) == 503:
+                    saw_503 = True
+                    break
+                time.sleep(0.02)
+            assert saw_503, "healthz never went 503 during the abort"
+            # ... -> 200 again after the rejoin.
+            while time.monotonic() < deadline:
+                if _healthz(srv.port) == 200:
+                    break
+                time.sleep(0.05)
+            assert _healthz(srv.port) == 200, \
+                "healthz never recovered to 200"
+            for f in futs:
+                res = f.result(timeout=60)
+                assert res.metrics["finish_reason"] == "error", res.metrics
+            assert sess.recoveries == 1, sess.recoveries
+            # The degraded session is a live session: post-recovery
+            # traffic completes normally.
+            res = sess.submit(np.arange(5, dtype=np.int32),
+                              max_tokens=4).result(timeout=60)
+            assert res.metrics["finish_reason"] == "length", res.metrics
+            assert len(res.tokens) == 4
+    finally:
+        disarm()
+        srv.close()
+    print("CHAOS-SERVING-OK healthz 200->503->200, aborts carry "
+          "finish_reason=error")
+
+
+# ---------------------------------------------------------------------------
+# scenario: determinism (same seed => identical fault sequence)
+# ---------------------------------------------------------------------------
+
+def scenario_determinism() -> None:
+    from . import FaultInjector, parse_spec
+    from ..obs import REGISTRY
+
+    spec = ("kv_get:err:p=0.02:seed=7; kv_put:err:p=0.1:seed=5; "
+            "negotiate:delay=1ms:p=0.05:seed=3")
+    schedule = (["kv_get"] * 400 + ["kv_put"] * 200
+                + ["negotiate"] * 300)
+
+    def drive() -> tuple:
+        inj = FaultInjector(parse_spec(spec))
+        before = REGISTRY.get("hvd_faults_injected_total").total()
+        for site in schedule:
+            try:
+                inj.fire(site)
+            except ConnectionError:
+                pass
+        return (inj.fired_events(),
+                REGISTRY.get("hvd_faults_injected_total").total() - before)
+
+    events_a, count_a = drive()
+    events_b, count_b = drive()
+    assert events_a == events_b, "same seed, different fault sequence"
+    assert count_a == count_b and count_a > 0, (count_a, count_b)
+    print(f"CHAOS-DETERMINISM-OK {count_a:.0f} faults, "
+          "bit-identical sequence on re-run")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.chaos.run",
+        description="chaos scenario harness (the chaos-recovery CI job)")
+    p.add_argument("--worker", action="store_true",
+                   help=argparse.SUPPRESS)   # internal np=4 worker
+    p.add_argument("--scenario", default="all",
+                   choices=("all", "elastic", "serving", "determinism"))
+    p.add_argument("--np", type=int, default=4, dest="np_total")
+    p.add_argument("--verbose", "-v", action="store_true")
+    args = p.parse_args(argv)
+    if args.worker:
+        return worker_main()
+
+    if args.scenario in ("all", "elastic"):
+        scenario_elastic(args.np_total, verbose=args.verbose)
+    if args.scenario in ("all", "serving"):
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+            " --xla_force_host_platform_device_count=1"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        scenario_serving()
+    if args.scenario in ("all", "determinism"):
+        scenario_determinism()
+    print("CHAOS-OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -146,6 +146,26 @@ class Config:
     stall_warning_time_s: float = 60.0
     stall_shutdown_time_s: float = 0.0  # 0 = never abort
 
+    # --- fault injection (horovod_tpu.chaos) ---
+    # Deterministic fault spec, e.g.
+    # "kv_get:err:p=0.02:seed=7; rank=1:die:after=50; negotiate:delay=300ms:p=0.05".
+    # None = disarmed.  Parsed strictly at init() (a chaos plan that
+    # cannot be honored must fail loudly, not run a healthy job).
+    faults: Optional[str] = None
+
+    # --- /healthz readiness (obs/server.py + context) ---
+    # Answer 503 when the engine's last completed negotiation is older
+    # than this many seconds (a wedged peer / dead controller leaves
+    # this rank unable to progress).  0 disables the age check.
+    health_max_negotiation_age_s: float = 0.0
+
+    # --- elastic blacklist decay (runner/elastic.py) ---
+    # First-failure cooldown before a blacklisted host is re-admitted on
+    # probation; each further failure doubles it (capped below).  <= 0
+    # restores the permanent blacklist.
+    blacklist_cooldown_s: float = 60.0
+    blacklist_max_cooldown_s: float = 600.0
+
     # --- logging († logging.cc) ---
     log_level: str = "warning"  # trace|debug|info|warning|error|fatal
     log_hide_timestamp: bool = False
@@ -210,6 +230,10 @@ _ENV_TABLE = [
     ("stall_check", "STALL_CHECK_DISABLE", lambda v: not _parse_bool(v)),
     ("stall_warning_time_s", "STALL_CHECK_TIME_SECONDS", float),
     ("stall_shutdown_time_s", "STALL_SHUTDOWN_TIME_SECONDS", float),
+    ("faults", "FAULTS", str),
+    ("health_max_negotiation_age_s", "HEALTH_MAX_NEGOTIATION_AGE", float),
+    ("blacklist_cooldown_s", "BLACKLIST_COOLDOWN_SECONDS", float),
+    ("blacklist_max_cooldown_s", "BLACKLIST_MAX_COOLDOWN_SECONDS", float),
     ("log_level", "LOG_LEVEL", str),
     ("log_hide_timestamp", "LOG_HIDE_TIME", _parse_bool),
     ("hierarchical_allreduce", "HIERARCHICAL_ALLREDUCE", _parse_bool),

@@ -122,6 +122,14 @@ def init(
         hvd_logging.configure(cfg.log_level, hide_timestamp=cfg.log_hide_timestamp)
         _state.config = cfg
 
+        if cfg.faults:
+            # Strict (unlike the import-time env arming, which must not
+            # crash imports): a requested fault plan with a typo must
+            # fail the job, not silently run it healthy.  Re-arming an
+            # identical spec on elastic re-init keeps injector state.
+            from . import chaos
+            chaos.arm(cfg.faults)
+
         if cfg.platform:
             # Must land before any backend initializes; wins over the
             # image's sitecustomize-pinned platform, unlike the env var.
@@ -303,21 +311,58 @@ def _arm_obs_plane() -> None:
     obs_server.set_health_provider(_health_snapshot)
 
 
+_component_lock = threading.Lock()
+_components: dict = {}
+
+
+def set_component_health(name: str, ready, **info) -> None:
+    """Subsystem readiness feeding ``/healthz``: any registered
+    component reporting unready holds the whole probe at 503 (a serving
+    session drains this way while it aborts and rejoins after an engine
+    failure).  ``ready=None`` deregisters the component.  Components
+    survive ``shutdown()`` — an elastic re-init must not forget that a
+    serving session is still mid-drain."""
+    with _component_lock:
+        if ready is None:
+            _components.pop(name, None)
+        else:
+            _components[name] = {"ready": bool(ready), **info}
+
+
 def _health_snapshot() -> dict:
     """The ``/healthz`` payload: is this rank able to serve/train right
     now, and how fresh is its view of the job."""
     eng = _state.engine
     alive = bool(eng is not None and eng.alive)
+    ready = bool(_state.initialized and alive)
+    status = "ok" if ready else "unready"
     d = {
-        "ready": bool(_state.initialized and alive),
-        "status": "ok" if (_state.initialized and alive) else "unready",
         "rank": jax.process_index(),
         "size": jax.process_count(),
         "engine_alive": alive,
         "uptime_s": round(time.monotonic() - _START_MONO, 3),
     }
     if eng is not None:
-        d["last_negotiation_age_s"] = round(eng.last_negotiation_age_s, 3)
+        age = eng.last_negotiation_age_s
+        d["last_negotiation_age_s"] = round(age, 3)
+        limit = _state.config.health_max_negotiation_age_s
+        if ready and limit > 0 and age > limit:
+            # A wedged/stalled negotiation (peer withholding its
+            # check-in, controller gone) means this rank cannot make
+            # progress — answer 503 so probes pull it from rotation
+            # before callers time out against it.
+            ready = False
+            status = "stalled"
+    with _component_lock:
+        comps = {k: dict(v) for k, v in _components.items()}
+    if comps:
+        d["components"] = comps
+        down = [k for k, v in comps.items() if not v.get("ready")]
+        if ready and down:
+            ready = False
+            status = "degraded:" + ",".join(sorted(down))
+    d["ready"] = ready
+    d["status"] = status
     return d
 
 

@@ -305,7 +305,10 @@ class RankPublisher:
 
     def publish_now(self) -> bool:
         """One publish attempt; False (never an exception) on transport
-        trouble — telemetry must not take the job down."""
+        trouble — telemetry must not take the job down.  Transient KV
+        errors retry under the shared backoff policy, but only within
+        half a publish cadence: a slow store must drop THIS snapshot
+        rather than make the publisher fall permanently behind."""
         from ..runner.api import kv_put_blob
         blob = local_snapshot_blob(
             self.rank, self.size, registry=self._registry,
@@ -318,7 +321,8 @@ class RankPublisher:
                     self._kv = self._kv_factory()
                 if self._kv is None:
                     return False
-                kv_put_blob(self._kv, f"{SNAP_PREFIX}{self.rank}", blob)
+                kv_put_blob(self._kv, f"{SNAP_PREFIX}{self.rank}", blob,
+                            deadline_s=max(0.25, self._interval / 2))
                 return True
             except (ConnectionError, OSError, TimeoutError) as e:
                 self._drop_kv()

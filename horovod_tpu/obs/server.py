@@ -158,11 +158,25 @@ _singleton_lock = threading.Lock()
 def start(port: int, *, addr: str = "") -> MetricsServer:
     """Start (or return) the process-wide endpoint on the default
     registry.  Idempotent: the first call wins; later calls return the
-    running server regardless of port."""
+    running server regardless of port.
+
+    The bind retries briefly on the shared backoff policy: after an
+    elastic relaunch the previous incarnation's socket can sit in
+    TIME_WAIT for a moment, and losing the scrape endpoint for the
+    whole next life of the job over that is silly.  A port some OTHER
+    process really owns still fails (and multi-worker jobs expect that
+    on all but one worker) — three quick attempts lose ~0.15s."""
     global _singleton
     with _singleton_lock:
         if _singleton is None:
-            _singleton = MetricsServer(port, addr=addr)
+            from ..utils import retry as _retry
+            _singleton = _retry.retry_call(
+                lambda: MetricsServer(port, addr=addr),
+                op="metrics_bind",
+                policy=_retry.RetryPolicy(max_attempts=3,
+                                          base_delay_s=0.05,
+                                          max_delay_s=0.2,
+                                          retryable=(OSError,)))
             from ..utils import logging as hvd_logging
             hvd_logging.get_logger().info(
                 "metrics endpoint listening on :%d (/metrics, "

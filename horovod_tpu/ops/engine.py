@@ -38,6 +38,7 @@ from typing import Any, Callable, Optional, Sequence
 
 from . import collectives as C
 from . import reduction as _R
+from .. import chaos
 from ..obs import REGISTRY as _obs
 from ..obs import flightrec as _frec
 from ..obs import trace as _trace
@@ -284,6 +285,11 @@ class SingleControllerNegotiator(Negotiator):
 
     def negotiate(self, entries: list[TensorTableEntry], *,
                   joined: bool = False) -> NegotiationOutcome:
+        if entries:
+            # Chaos site (single-controller half; the distributed
+            # negotiator fires it at its barrier entry) — lets
+            # single-process chaos tests exercise the round-abort path.
+            chaos.fire("negotiate")
         return NegotiationOutcome(ready=[e.name for e in entries])
 
 
@@ -779,6 +785,11 @@ class CollectiveEngine:
             from jax.profiler import TraceAnnotation
             label = (group[0].name if len(group) == 1
                      else f"hvd.fused[{len(group)}].{group[0].name}")
+            # Chaos site: one traversal per fused dispatch.  err lands
+            # in this handler's error path (HorovodInternalError to
+            # every waiter — the elastic recovery trigger); die is the
+            # injected rank death the chaos CI scenario rides.
+            chaos.fire("dispatch")
             with TraceAnnotation(f"hvd.{group[0].verb}:{label}"):
                 results = self._dispatch(group)
             if tl is not None and tl.enabled:

@@ -29,6 +29,7 @@ import time
 from typing import Optional
 
 from .engine import NegotiationOutcome, Negotiator, TensorTableEntry
+from .. import chaos
 from ..obs import REGISTRY as _obs
 from ..utils import logging as hvd_logging
 
@@ -76,6 +77,10 @@ class DistributedNegotiator(Negotiator):
                 # forever for ranks that never submit it.
                 members = ",".join(str(r) for r in e.process_set.ranks)
             pairs.append((e.name, e.meta(), members))
+        # Chaos site: barrier entry.  A delay here holds THIS rank's
+        # check-in (its peers see it as a straggler and /healthz ages);
+        # an err aborts the round exactly like controller TCP trouble.
+        chaos.fire("negotiate")
         t0 = time.monotonic()
         res = self._client.negotiate(pairs, joined=joined)
         self.last_negotiate_ts = time.monotonic()

@@ -39,9 +39,14 @@ down and :func:`run_func` raises with every collected failure.
 
 from __future__ import annotations
 
+import dataclasses as _dc
 import os
 import threading
+import time
 from typing import Any, List, Optional, Sequence
+
+from .. import chaos
+from ..utils import retry as _retry
 
 # Control-plane frames cap at 8 MiB (native/hvdtpu_core.cc recv guard);
 # chunk well under it to leave room for HMAC/framing overhead.
@@ -52,7 +57,9 @@ _RESULT_KEY = "runfunc/result/{rank}"
 _ACK_KEY = "runfunc/ack/{rank}"
 
 
-def kv_put_blob(kv, prefix: str, data: bytes) -> None:
+def kv_put_blob(kv, prefix: str, data: bytes, *,
+                policy: _retry.RetryPolicy = _retry.KV_POLICY,
+                deadline_s: Optional[float] = None) -> None:
     """Store ``data`` under ``prefix`` in ≤4 MiB chunks.
 
     The meta key goes LAST so a blocking reader that sees it can read
@@ -60,29 +67,79 @@ def kv_put_blob(kv, prefix: str, data: bytes) -> None:
     so a reader racing a REWRITE of the same prefix (the obs plane
     republishes ``obs/rank/<r>`` every interval; run_func keys are
     write-once and never hit this) detects the torn read instead of
-    returning spliced bytes."""
+    returning spliced bytes.
+
+    Transient store errors retry under the shared backoff policy with
+    ONE overall ``deadline_s`` across every chunk write.  The default
+    budget scales with the blob (2s per 4 MiB chunk, 10s floor) so a
+    large run_func result is never failed by a flat timeout a small
+    blob sized; callers with a real cadence to protect (the obs
+    publisher) pass a tight explicit deadline instead."""
     n = max(1, (len(data) + _CHUNK - 1) // _CHUNK)
+    if deadline_s is None:
+        deadline_s = max(10.0, 2.0 * n)
+    policy = _dc.replace(policy, deadline_s=deadline_s)
+    deadline = time.monotonic() + deadline_s
+
+    def put(key: str, value: bytes) -> None:
+        def attempt():
+            chaos.fire("kv_put")
+            if time.monotonic() > deadline:
+                raise _Expired(
+                    f"kv_put_blob({prefix!r}): {deadline_s}s overall "
+                    "deadline exceeded")
+            kv.set(key, value)
+        _retry.retry_call(attempt, op="kv_put", policy=policy)
+
     for i in range(n):
-        kv.set(f"{prefix}/{i}", data[i * _CHUNK:(i + 1) * _CHUNK])
-    kv.set(f"{prefix}/meta", f"{n}:{len(data)}".encode())
+        put(f"{prefix}/{i}", data[i * _CHUNK:(i + 1) * _CHUNK])
+    put(f"{prefix}/meta", f"{n}:{len(data)}".encode())
 
 
 def kv_get_blob(kv, prefix: str, timeout_ms: int = 10000) -> bytes:
     """Blocking fetch of a chunked blob stored by :func:`kv_put_blob`.
 
+    ``timeout_ms`` is ONE overall deadline shared by the meta wait and
+    every chunk wait — each wait gets only the remaining budget, so a
+    flaky store can never stretch the call to ``chunks x timeout`` (the
+    pre-retry-policy behavior restarted the full timeout per chunk).
+    Transient errors inside the window retry on the shared backoff
+    policy.
+
     Raises ``ValueError`` when the assembled length contradicts the
     meta record (concurrent rewrite of the prefix) — callers on
     rewritable keys retry or skip; write-once keys never see it."""
-    meta = kv.wait(f"{prefix}/meta", timeout_ms=timeout_ms).decode()
+    deadline = time.monotonic() + timeout_ms / 1000.0
+
+    def wait_key(key: str) -> bytes:
+        def attempt():
+            chaos.fire("kv_get")
+            remaining_ms = int((deadline - time.monotonic()) * 1000)
+            if remaining_ms <= 0:
+                raise _Expired(
+                    f"kv_get_blob({prefix!r}): {timeout_ms}ms overall "
+                    f"deadline exceeded waiting for {key!r}")
+            return kv.wait(key, timeout_ms=remaining_ms)
+        policy = _dc.replace(
+            _retry.KV_POLICY,
+            deadline_s=max(0.0, deadline - time.monotonic()))
+        return _retry.retry_call(attempt, op="kv_get", policy=policy)
+
+    meta = wait_key(f"{prefix}/meta").decode()
     n_str, _, len_str = meta.partition(":")
     n = int(n_str)
-    blob = b"".join(kv.wait(f"{prefix}/{i}", timeout_ms=timeout_ms)
-                    for i in range(n))
+    blob = b"".join(wait_key(f"{prefix}/{i}") for i in range(n))
     if len_str and len(blob) != int(len_str):
         raise ValueError(
             f"blob {prefix!r} torn mid-rewrite "
             f"(meta says {len_str} bytes, read {len(blob)})")
     return blob
+
+
+class _Expired(_retry.Permanent, TimeoutError):
+    """Deadline-expired marker: still a ``TimeoutError`` for callers'
+    except clauses, but :class:`~horovod_tpu.utils.retry.Permanent`
+    vetoes retrying a budget that is already spent."""
 
 
 def _collect(kv, np_total: int, results: dict, stop: threading.Event) -> None:

@@ -16,15 +16,18 @@ their next commit, letting them exit cleanly for the relaunch).
 
 from __future__ import annotations
 
+import dataclasses
 import subprocess
 import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
 from .hosts import HostSlots, assign_ranks, parse_hosts
+from .. import config as config_mod
 from ..obs import REGISTRY as _obs
 from ..obs import flightrec as _frec
 from ..utils import logging as hvd_logging
+from ..utils import retry as _retry
 
 log = hvd_logging.get_logger()
 
@@ -37,6 +40,9 @@ _m_rendezvous_rounds = _obs.counter(
 _m_hosts = _obs.gauge(
     "hvd_elastic_available_hosts",
     "non-blacklisted hosts in the current assignment")
+_m_blacklisted = _obs.gauge(
+    "hvd_elastic_blacklisted_hosts",
+    "hosts currently serving a blacklist cooldown")
 _m_epoch = _obs.gauge(
     "hvd_elastic_membership_epoch",
     "membership epoch of the assignment the driver last launched "
@@ -91,19 +97,50 @@ class FixedDiscovery(HostDiscovery):
         return spec
 
 
+@dataclasses.dataclass
+class _BlacklistEntry:
+    """One host's failure history: probation instead of a life sentence."""
+    failures: int = 0
+    until: float = 0.0         # monotonic instant the cooldown expires
+    last_failure: float = 0.0
+
+
 class ElasticDriver:
-    """Membership brain: current hosts − blacklist → rank assignment."""
+    """Membership brain: current hosts − blacklist → rank assignment.
+
+    The blacklist DECAYS: a host's first crash excludes it for
+    ``blacklist_cooldown_s``; when the cooldown lapses the host is
+    re-admitted on probation, and a further crash doubles the cooldown
+    (capped at ``blacklist_max_cooldown_s``).  A transient failure
+    (preemption, OOM kill, flaky NIC) therefore costs bounded capacity,
+    while a persistently bad host spends almost all its time excluded —
+    the permanent blacklist it replaces ratcheted every transient
+    failure toward ``min_np`` forever.  ``cooldown <= 0`` restores the
+    permanent behavior.  ``clock`` is injectable so the decay schedule
+    unit-tests without sleeping.
+    """
 
     def __init__(self, discovery: HostDiscovery, *, min_np: int,
                  max_np: Optional[int] = None,
-                 poll_interval_s: float = 1.0) -> None:
+                 poll_interval_s: float = 1.0,
+                 blacklist_cooldown_s: Optional[float] = None,
+                 blacklist_max_cooldown_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
         if min_np < 1:
             raise ValueError("min_np must be >= 1")
+        cfg = config_mod.from_env()
         self._discovery = discovery
         self.min_np = min_np
         self.max_np = max_np
         self._poll_interval = poll_interval_s
-        self._blacklist: set[str] = set()
+        self._cooldown = (cfg.blacklist_cooldown_s
+                          if blacklist_cooldown_s is None
+                          else blacklist_cooldown_s)
+        self._max_cooldown = (cfg.blacklist_max_cooldown_s
+                              if blacklist_max_cooldown_s is None
+                              else blacklist_max_cooldown_s)
+        self._clock = clock
+        self._blacklist: dict[str, _BlacklistEntry] = {}
         self._lock = threading.Lock()
         self._current_hosts: List[HostSlots] = []
         self.membership_epoch = 0
@@ -111,16 +148,60 @@ class ElasticDriver:
     # -- membership ---------------------------------------------------------
     def blacklist(self, hostname: str) -> None:
         """† ``registration.py``: a host whose worker crashed is excluded
-        from future assignments."""
+        from future assignments — here for a decaying cooldown, not
+        forever (see the class docstring)."""
+        now = self._clock()
         with self._lock:
-            self._blacklist.add(hostname)
+            e = self._blacklist.setdefault(hostname, _BlacklistEntry())
+            e.failures += 1
+            e.last_failure = now
+            if self._cooldown > 0:
+                cooldown = min(self._cooldown * (2 ** (e.failures - 1)),
+                               self._max_cooldown)
+                e.until = now + cooldown
+            else:
+                cooldown = float("inf")
+                e.until = float("inf")
         _m_worker_failures.inc()
-        _frec.RECORDER.record("elastic_blacklist", name=hostname)
-        log.warning("elastic: blacklisted host %s", hostname)
+        _frec.RECORDER.record("elastic_blacklist", name=hostname,
+                              failures=e.failures,
+                              cooldown_s=(None if cooldown == float("inf")
+                                          else round(cooldown, 3)))
+        log.warning(
+            "elastic: blacklisted host %s (failure #%d, cooldown %s)",
+            hostname, e.failures,
+            "permanent" if cooldown == float("inf")
+            else f"{cooldown:.0f}s")
 
     def blacklisted(self) -> set[str]:
+        """Hosts currently serving a cooldown.  Hosts whose cooldown
+        lapsed are re-admitted (probation) but keep their failure
+        count, so the next failure doubles the cooldown."""
+        now = self._clock()
+        out = set()
+        readmitted = []
         with self._lock:
-            return set(self._blacklist)
+            for host, e in self._blacklist.items():
+                if now < e.until:
+                    out.add(host)
+                elif e.until:      # lapsed since we last looked
+                    e.until = 0.0
+                    readmitted.append((host, e.failures))
+        _m_blacklisted.set(len(out))
+        for host, failures in readmitted:
+            _frec.RECORDER.record("elastic_probation", name=host,
+                                  failures=failures)
+            log.warning(
+                "elastic: blacklist cooldown lapsed for host %s "
+                "(%d failure(s) so far) — re-admitting on probation",
+                host, failures)
+        return out
+
+    def blacklist_failures(self, hostname: str) -> int:
+        """Failure count a host has accrued (0 = never failed)."""
+        with self._lock:
+            e = self._blacklist.get(hostname)
+            return e.failures if e else 0
 
     def poll_hosts(self) -> bool:
         """Refresh from discovery; returns True if membership changed."""
@@ -137,21 +218,47 @@ class ElasticDriver:
                                  timeout_s: float = 600.0
                                  ) -> List[HostSlots]:
         """† ``ElasticDriver.wait_for_available_slots``: block until at
-        least min_np slots exist among non-blacklisted hosts."""
+        least min_np slots exist among non-blacklisted hosts.
+
+        Discovery failures (the script crashing, timing out, or its
+        host being briefly unreachable) no longer kill the driver: they
+        back off on the shared retry policy — exponential, capped,
+        deterministic jitter — and only the overall ``timeout_s``
+        budget gives up.  A healthy poll resets the backoff to the
+        plain poll interval."""
         need = min_np if min_np is not None else self.min_np
         deadline = time.monotonic() + timeout_s
+        backoff = _retry.Backoff(
+            _retry.RetryPolicy(max_attempts=None,
+                               base_delay_s=max(0.05, self._poll_interval),
+                               max_delay_s=max(8 * self._poll_interval,
+                                               self._poll_interval)),
+            op="elastic_discovery")
+        last_err: Optional[Exception] = None
         while True:
-            self.poll_hosts()
+            try:
+                self.poll_hosts()
+                backoff.reset()
+                last_err = None
+            except Exception as e:
+                last_err = e
+                log.warning("elastic: host discovery failed (%s); "
+                            "retrying with backoff", e)
             with self._lock:
                 hosts = list(self._current_hosts)
-            if sum(h.slots for h in hosts) >= need:
+            if last_err is None and sum(h.slots for h in hosts) >= need:
                 return hosts
-            if time.monotonic() >= deadline:
+            now = time.monotonic()
+            if now >= deadline:
                 raise TimeoutError(
                     f"not enough hosts for min_np={need} within "
                     f"{timeout_s}s (have {hosts}, "
-                    f"blacklist {sorted(self.blacklisted())})")
-            time.sleep(self._poll_interval)
+                    f"blacklist {sorted(self.blacklisted())}"
+                    + (f", last discovery error: {last_err}" if last_err
+                       else "") + ")")
+            delay = (backoff.next_delay() if last_err is not None
+                     else self._poll_interval)
+            time.sleep(min(delay, max(0.0, deadline - now)))
 
     def assignment(self, hosts: Optional[Sequence[HostSlots]] = None
                    ) -> List[tuple[int, str, int]]:
@@ -297,7 +404,13 @@ class ElasticDriver:
                 return code
             # Refresh membership and let discovery/blacklist shape the
             # next assignment (a grown host list enlarges it; a crashed
-            # host's blacklisting shrinks it).
-            self.poll_hosts()
+            # host's blacklisting shrinks it).  A discovery hiccup here
+            # is not fatal — the next wait_for_available_slots retries
+            # it under the backoff policy.
+            try:
+                self.poll_hosts()
+            except Exception as e:
+                log.warning("elastic: post-round discovery poll failed "
+                            "(%s); retrying at next slot wait", e)
             if on_epoch_change and self.membership_epoch != epoch:
                 on_epoch_change(self.membership_epoch)

@@ -30,6 +30,7 @@ import time
 from typing import List, Optional, Sequence
 
 from .hosts import assign_ranks, parse_hosts
+from .. import chaos
 from .. import config as config_mod
 
 
@@ -301,6 +302,10 @@ def launch_workers(command: Sequence[str], *, np_total: int,
 
     try:
         for rank, host, local_rank in assignment:
+            # Chaos site: one traversal per worker spawned.  err aborts
+            # the launch (the elastic driver counts it as a failed
+            # round and relaunches); delay staggers worker starts.
+            chaos.fire("spawn")
             env = base_env(rank, local_rank)
             if host in ("localhost", "127.0.0.1", my_ip):
                 proc = subprocess.Popen(
@@ -350,6 +355,10 @@ def launch_workers(command: Sequence[str], *, np_total: int,
         pending = {w.rank: w for w in workers}
         code = 0
         while pending:
+            # Chaos site: one traversal per monitor liveness pass (the
+            # launcher's heartbeat over its workers) — a driver-side
+            # fault here tears the job down like a dying launcher would.
+            chaos.fire("heartbeat")
             for rank_id, w in list(pending.items()):
                 rc = w.proc.poll()
                 if rc is None:

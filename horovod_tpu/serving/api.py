@@ -30,6 +30,8 @@ from typing import Any, Callable, Optional, Sequence
 import numpy as np
 
 from ..obs import REGISTRY as _obs
+from ..obs import flightrec as _frec
+from ..ops.engine import HorovodInternalError
 from ..utils import logging as hvd_logging
 from ..utils.timeline import Timeline
 from .engine import EngineConfig, ServingEngine
@@ -79,7 +81,10 @@ class ServingSession:
 
     def __init__(self, engine: ServingEngine, *,
                  timeline: Optional[Timeline] = None,
-                 own_timeline: bool = True) -> None:
+                 own_timeline: bool = True,
+                 recover: bool = True,
+                 max_recoveries: int = 3,
+                 recovery_pause_s: float = 0.0) -> None:
         self.engine = engine
         # own_timeline=False: the timeline is borrowed (the runtime's
         # global Timeline v2) and must survive this session's close().
@@ -91,6 +96,16 @@ class ServingSession:
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Graceful degradation: an engine-step failure aborts in-flight
+        # requests (error finish_reason), holds /healthz at 503 through
+        # the drain window, rejoins (elastic re-rendezvous for
+        # collective failures), and resumes — instead of dying.
+        self._recover = recover
+        self._max_recoveries = max_recoveries
+        self._recovery_pause_s = recovery_pause_s
+        self.recoveries = 0
+        from ..context import set_component_health
+        set_component_health("serving", True)
 
     # -- client surface --------------------------------------------------
     def submit(self, prompt: Sequence[int], max_tokens: int, *,
@@ -171,6 +186,8 @@ class ServingSession:
             self._thread = None
         if self._own_timeline:
             self._timeline.close()
+        from ..context import set_component_health
+        set_component_health("serving", None)
 
     def __enter__(self) -> "ServingSession":
         return self
@@ -180,9 +197,13 @@ class ServingSession:
 
     # -- engine pump -----------------------------------------------------
     def _step_once(self) -> None:
-        with self._lock:
-            emissions = self.engine.step()
-            failed = self.engine.pop_failed()
+        try:
+            with self._lock:
+                emissions = self.engine.step()
+                failed = self.engine.pop_failed()
+        except Exception as e:
+            self._handle_engine_failure(e)
+            return
         for req, exc in failed:
             self._t_last_emit.pop(req.req_id, None)
             _m_requests.labels(outcome="failed").inc()
@@ -230,10 +251,69 @@ class ServingSession:
                 req_id=req.req_id, prompt=req.prompt,
                 tokens=list(req.generated), metrics=m))
 
+    # -- graceful degradation --------------------------------------------
+    def _handle_engine_failure(self, exc: BaseException) -> None:
+        """One engine-step failure, survived: abort in-flight requests
+        with an ``error`` finish_reason (futures resolve to their
+        partial results — streamed tokens are already delivered, not
+        lied about), hold ``/healthz`` at 503 through the drain window,
+        rejoin through elastic re-rendezvous when the failure was a
+        collective abort, then resume serving.  Past
+        ``max_recoveries`` the failure is re-raised (a permanently sick
+        engine should die loudly, not flap)."""
+        from ..context import is_initialized, set_component_health
+        self.recoveries += 1
+        log.error("serving: engine step failed (%s); aborting in-flight "
+                  "requests and degrading (recovery %d/%d)",
+                  exc, self.recoveries, self._max_recoveries)
+        set_component_health("serving", False,
+                             reason=f"engine step failed: {exc}")
+        _frec.RECORDER.record("serving_abort", error=repr(exc),
+                              recovery=self.recoveries)
+        with self._lock:
+            aborted = self.engine.abort_inflight(exc)
+            futs = [(req, self._futures.pop(req.req_id, None))
+                    for req in aborted]
+        for req, fut in futs:
+            self._t_last_emit.pop(req.req_id, None)
+            _m_requests.labels(outcome="aborted").inc()
+            if fut is not None and not fut.done():
+                m = req.metrics()
+                m["error"] = str(exc)
+                fut.set_result(RequestResult(
+                    req_id=req.req_id, prompt=req.prompt,
+                    tokens=list(req.generated), metrics=m))
+        if self.recoveries > self._max_recoveries or not self._recover:
+            _frec.RECORDER.maybe_dump("serving_abort",
+                                      extra={"error": repr(exc)})
+            raise exc
+        if self._recovery_pause_s:
+            # The drain window: probes must see 503 long enough for a
+            # router to pull this replica before traffic resumes.
+            time.sleep(self._recovery_pause_s)
+        if isinstance(exc, HorovodInternalError) and is_initialized():
+            # Collective failure: the mesh itself is suspect — rejoin
+            # through the elastic path (shutdown -> init -> republish)
+            # so this replica re-rendezvouses instead of serving on a
+            # dead world.
+            try:
+                from ..elastic.runner import _reinitialize
+                _reinitialize()
+            except Exception as e2:
+                set_component_health(
+                    "serving", False,
+                    reason=f"re-rendezvous failed: {e2}")
+                raise
+        set_component_health("serving", True)
+        log.warning("serving: recovered after engine failure (%d request"
+                    "(s) aborted); accepting traffic again", len(futs))
+
 
 def serve(params: Any, cfg, *, mesh=None,
           engine_cfg: Optional[EngineConfig] = None,
-          timeline: Optional[Timeline] = None, **engine_kw
+          timeline: Optional[Timeline] = None,
+          recover: bool = True, max_recoveries: int = 3,
+          recovery_pause_s: float = 0.0, **engine_kw
           ) -> ServingSession:
     """Build a serving session for a model.
 
@@ -244,6 +324,13 @@ def serve(params: Any, cfg, *, mesh=None,
         fut = session.submit(prompt_ids, max_tokens=64)
         session.drain()
         print(fut.result().tokens)
+
+    ``recover``/``max_recoveries``/``recovery_pause_s`` configure the
+    graceful-degradation loop: on an engine-step failure the session
+    aborts in-flight requests with an ``error`` finish_reason, answers
+    503 on ``/healthz`` through the drain window (``recovery_pause_s``),
+    re-rendezvouses when the failure was a collective abort, and
+    resumes — see :meth:`ServingSession._handle_engine_failure`.
     """
     base = engine_cfg or EngineConfig()
     if engine_kw:
@@ -264,4 +351,6 @@ def serve(params: Any, cfg, *, mesh=None,
     engine = ServingEngine(params, cfg, engine_cfg=base, mesh=mesh,
                            timeline=timeline)
     return ServingSession(engine, timeline=timeline,
-                          own_timeline=own_timeline)
+                          own_timeline=own_timeline, recover=recover,
+                          max_recoveries=max_recoveries,
+                          recovery_pause_s=recovery_pause_s)

@@ -25,16 +25,18 @@ runs; this owns how it runs on devices):
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Any, Optional
 
 import numpy as np
 
 from ..models import llama
+from .. import chaos
 from ..obs import REGISTRY as _obs
 from ..obs import trace as _trace
 from ..utils import logging as hvd_logging
-from .kv_pager import KVPager, PagedKVCache
+from .kv_pager import KVPager, OutOfBlocks, PagedKVCache
 from .scheduler import Request, RequestState, Scheduler
 
 log = hvd_logging.get_logger()
@@ -197,6 +199,10 @@ class ServingEngine:
     # -- public surface --------------------------------------------------
     def submit(self, prompt, max_new_tokens: int, *, eos_token=None,
                stream_cb=None) -> Request:
+        # Chaos site: admission.  err rejects the request before it
+        # queues (the caller sees the raise, nothing leaks into the
+        # scheduler); delay throttles intake.
+        chaos.fire("serving_admit")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -237,7 +243,15 @@ class ServingEngine:
         return failed
 
     def step(self) -> list[tuple[Request, int]]:
-        """One serving round; returns the (request, token) emissions."""
+        """One serving round; returns the (request, token) emissions.
+
+        A raise out of here (device failure, collective abort, injected
+        fault) leaves the scheduler/pager bookkeeping consistent enough
+        for :meth:`abort_inflight` — the session layer catches, aborts
+        the in-flight set with an ``error`` finish_reason, flips
+        ``/healthz``, and drains-and-rejoins instead of dying."""
+        # Chaos site: one traversal per serving round (decode step).
+        chaos.fire("serving_step")
         emitted: list[tuple[Request, int]] = []
         self._steps += 1
         _m_steps.inc()
@@ -333,7 +347,15 @@ class ServingEngine:
         # growth can preempt, shrinking the running set.
         for req in list(self.scheduler.running):
             if req in self.scheduler.running:
-                self.scheduler.grow(req)
+                try:
+                    self.scheduler.grow(req)
+                except OutOfBlocks as e:
+                    # Only reachable when req cannot fit even alone
+                    # (grow preempts every other victim first): fail
+                    # THIS request and keep the batch serving — a
+                    # per-request capacity problem must not abort the
+                    # engine.
+                    self.scheduler.fail_running(req, e)
         self._sync_slots()
         active = [r for r in self._slots if r is not None]
         if not active:
@@ -366,9 +388,33 @@ class ServingEngine:
 
     def _emit(self, req: Request, token: int) -> int:
         req.generated.append(token)
-        done = (len(req.generated) >= req.max_new_tokens
-                or (req.eos_token is not None and token == req.eos_token))
+        eos = req.eos_token is not None and token == req.eos_token
+        done = eos or len(req.generated) >= req.max_new_tokens
         if done:
+            req.finish_reason = "stop" if eos else "length"
             self.scheduler.finish(req)
             self._drop_slot(req)
         return token
+
+    def abort_inflight(self, exc: BaseException) -> list[Request]:
+        """Graceful-degradation half of a step failure: finish every
+        queued and running request NOW with ``finish_reason="error"``
+        (partial tokens preserved — streamed clients already hold
+        them), release their pool blocks, and leave the engine empty
+        and reusable.  Returns the aborted requests; the session layer
+        resolves their futures and owns the /healthz + rejoin story."""
+        aborted: list[Request] = []
+        for req in list(self.scheduler.running):
+            self.scheduler.running.remove(req)
+            self.pager.release(req.req_id)
+            aborted.append(req)
+        while self.scheduler.waiting:
+            aborted.append(self.scheduler.waiting.popleft())
+        for req in aborted:
+            req.state = RequestState.CANCELLED
+            req.finish_reason = "error"
+            req.t_finished = time.monotonic()
+            req.close_trace("aborted", error=str(exc))
+        self._slots = [None] * self.ecfg.max_active
+        self._sample_gauges()
+        return aborted

@@ -59,6 +59,12 @@ class Request:
     eos_token: Optional[int] = None
     stream_cb: Optional[Callable[[int, int], None]] = None
     state: RequestState = RequestState.WAITING
+    #: why the request stopped: "stop" (eos), "length" (max_new_tokens),
+    #: "error" (failed/aborted — the graceful-degradation contract: an
+    #: engine failure finishes in-flight requests with this reason and
+    #: their partial tokens instead of hanging them), "cancelled";
+    #: None while live.
+    finish_reason: Optional[str] = None
     #: tokens generated so far (grows per decode tick / prefill emit)
     generated: list[int] = dataclasses.field(default_factory=list)
     #: prompt actually prefilled (original + generated-before-preemption)
@@ -105,6 +111,7 @@ class Request:
                 (len(self.generated) - 1) / decode_s
                 if decode_s and len(self.generated) > 1 else None),
             "preemptions": self.preemptions,
+            "finish_reason": self.finish_reason,
             "trace_id": self.trace.trace_id,
         }
 
@@ -159,11 +166,18 @@ class Scheduler:
         return (self.pager.cache.blocks_for(n_tokens + 1)
                 <= self.pager.cache.num_blocks - 1)
 
-    def _fail(self, req: Request, why: str) -> None:
+    def _fail_terminal(self, req: Request, exc: Exception) -> None:
+        """The one place a request reaches ``self.failed``: terminal
+        bookkeeping shared by the waiting-queue and running-set failure
+        paths so the contract cannot drift between them."""
         req.state = RequestState.CANCELLED
+        req.finish_reason = "error"
         req.t_finished = self._clock()
-        req.close_trace("failed", error=why)
-        self.failed.append((req, OutOfBlocks(why)))
+        req.close_trace("failed", error=str(exc))
+        self.failed.append((req, exc))
+
+    def _fail(self, req: Request, why: str) -> None:
+        self._fail_terminal(req, OutOfBlocks(why))
 
     # -- queue surface ---------------------------------------------------
     def submit(self, req: Request) -> None:
@@ -190,6 +204,7 @@ class Scheduler:
 
     def cancel(self, req: Request) -> None:
         req.state = RequestState.CANCELLED
+        req.finish_reason = "cancelled"
         req.t_finished = self._clock()
         req.close_trace("cancelled")
         if req in self.running:
@@ -246,6 +261,15 @@ class Scheduler:
             if budget <= 0:
                 break
         return admitted
+
+    def fail_running(self, req: Request, exc: Exception) -> None:
+        """Fail one RUNNING request that cannot continue (it cannot fit
+        in the pool even alone) without disturbing the rest of the
+        batch — a per-request capacity problem is not an engine
+        failure, so it must not trip the session's degradation path."""
+        self.running.remove(req)
+        self.pager.release(req.req_id)
+        self._fail_terminal(req, exc)
 
     def grow(self, req: Request) -> None:
         """Reserve pool space for ``req``'s next position, preempting the

@@ -19,6 +19,16 @@ Modes (``HVDTPU_TEST_MODE``):
   bundle (dir from ``HVDTPU_FLIGHT_RECORDER_DIR``) whose stall
   attribution names rank 1 — missing-rank list AND bitmap — next to the
   event ring and the registry snapshot.
+- ``chaos`` (np=2): /healthz under injected faults.  Rank 1 arms a
+  chaos spec delaying its negotiation check-in 2.5s; rank 0 (with
+  ``HVDTPU_HEALTH_MAX_NEGOTIATION_AGE=1``) must observe its own
+  ``/healthz`` transition 200 → 503 (the stall) → 200 (recovery).
+  Rank 0 then takes an injected serving-step fault and must observe
+  503 again through the serving drain window and 200 after the
+  session recovers, with the aborted request carrying
+  ``finish_reason="error"``; finally rank 1's
+  ``hvd_faults_injected_total{site="negotiate",kind="delay"}`` must
+  arrive rank-labeled on the aggregated ``/cluster`` view.
 """
 
 import glob
@@ -185,6 +195,99 @@ def cluster_mode(me: int, n: int) -> int:
     return 0
 
 
+def _healthz_code(port: int) -> int:
+    import urllib.error
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def _wait_healthz(port: int, want: int, deadline_s: float = 30.0) -> None:
+    deadline = time.monotonic() + deadline_s
+    seen = []
+    while time.monotonic() < deadline:
+        code = _healthz_code(port)
+        seen.append(code)
+        if code == want:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"healthz never answered {want} (saw {sorted(set(seen))})")
+
+
+def chaos_mode(me: int, n: int) -> int:
+    from horovod_tpu import chaos
+
+    hvd.barrier()
+    if me == 1:
+        # Give rank 0 a beat to start polling, then stall our next
+        # negotiation check-in for 2.5s — rank 0 blocks in the round
+        # barrier and its negotiation age crosses the 1s health limit.
+        time.sleep(0.3)
+        chaos.arm("negotiate:delay=2500ms:times=1")
+        hvd.barrier()              # reached only after the stall clears
+        hvd.barrier()              # rank 0's serving pass
+        hvd.barrier()              # rank 0's /cluster check: exiting
+        # earlier would retract this rank's snapshot mid-aggregation
+        print(f"rank {me}: CHAOS-STALLER-OK")
+        return 0
+
+    srv = server.MetricsServer(0, addr="127.0.0.1")
+    try:
+        assert _healthz_code(srv.port) == 200
+        # -- injected negotiation stall: 200 -> 503 -> 200 ------------
+        _wait_healthz(srv.port, 503)
+        _wait_healthz(srv.port, 200)
+        hvd.barrier()
+
+        # -- injected serving fault: 503 through the drain window -----
+        import jax
+        from horovod_tpu import serving
+        from horovod_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        sess = serving.serve(params, cfg, num_blocks=16, block_size=8,
+                             max_active=2, recovery_pause_s=0.75)
+        with sess:
+            chaos.arm("serving_step:err:after=2:times=1")
+            try:
+                fut = sess.submit(np.arange(4, dtype=np.int32),
+                                  max_tokens=8)
+                sess.start()
+                _wait_healthz(srv.port, 503)
+                _wait_healthz(srv.port, 200)
+                res = fut.result(timeout=60)
+                assert res.metrics["finish_reason"] == "error", res.metrics
+                assert sess.recoveries == 1
+            finally:
+                chaos.disarm()
+        hvd.barrier()
+
+        # -- the injected fault is visible on /cluster, rank-labeled --
+        deadline = time.monotonic() + 30.0
+        while True:
+            snap = hvd.cluster_metrics()
+            fam = _cluster_family(snap, "hvd_faults_injected_total")
+            hit = [s for s in (fam["samples"] if fam else [])
+                   if s["labels"].get("rank") == "1"
+                   and s["labels"].get("site") == "negotiate"
+                   and s["labels"].get("kind") == "delay"]
+            if hit and hit[0]["value"] == 1.0:
+                break
+            assert time.monotonic() < deadline, \
+                f"rank 1's injected fault never aggregated: {fam}"
+            time.sleep(0.2)
+        hvd.barrier()              # release rank 1 to exit
+    finally:
+        srv.close()
+    print(f"rank {me}: CHAOS-OK")
+    return 0
+
+
 def stall_mode(me: int, n: int) -> int:
     if me < 3:
         h = hvd.allreduce_async(
@@ -274,6 +377,8 @@ def main() -> int:
         rc = stall_mode(me, n)
     elif mode == "flightrec":
         rc = flightrec_mode(me, n)
+    elif mode == "chaos":
+        rc = chaos_mode(me, n)
     else:
         raise SystemExit(f"unknown HVDTPU_TEST_MODE={mode!r}")
     hvd.shutdown()

@@ -1,0 +1,600 @@
+"""Chaos + robustness: fault-spec grammar, deterministic injection, the
+unified retry policy, kv blob deadlines, blacklist decay, and serving
+graceful degradation.
+
+The multi-process halves live in ``horovod_tpu/chaos/run.py`` (the CI
+``chaos-recovery`` scenario harness, wrapped slow-marked in
+``test_runner.py``) and ``tests/mp_obs_worker.py`` mode ``chaos``
+(/healthz 200→503→200 under an injected negotiation stall).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import chaos
+from horovod_tpu.chaos.spec import FaultRule, parse_duration_s, parse_spec
+from horovod_tpu.obs import REGISTRY
+from horovod_tpu.utils import retry
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    chaos.disarm()
+    yield
+    chaos.disarm()
+
+
+# ---------------------------------------------------------------------------
+# spec grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_spec_issue_example():
+    rules = parse_spec("kv_get:err:p=0.02:seed=7; rank=1:die:after=50steps;"
+                       " negotiate:delay=300ms:p=0.05")
+    assert rules[0] == FaultRule(site="kv_get", kind="err", index=0,
+                                 p=0.02, seed=7)
+    assert rules[1].site == "*" and rules[1].kind == "die"
+    assert rules[1].rank == 1 and rules[1].after == 50
+    assert rules[1].times == 1          # die defaults to once
+    assert rules[2].kind == "delay" and rules[2].delay_s == pytest.approx(0.3)
+    assert rules[2].p == 0.05
+
+
+def test_parse_spec_field_order_is_free():
+    a, = parse_spec("dispatch:rank=1:die:after=3")
+    b, = parse_spec("die:dispatch:after=3:rank=1")
+    assert a == b
+
+
+def test_parse_duration_units():
+    assert parse_duration_s("300ms") == pytest.approx(0.3)
+    assert parse_duration_s("0.3s") == pytest.approx(0.3)
+    assert parse_duration_s("2") == pytest.approx(2.0)
+    assert parse_duration_s("1m") == pytest.approx(60.0)
+    with pytest.raises(ValueError):
+        parse_duration_s("fast")
+
+
+@pytest.mark.parametrize("bad", [
+    "kv_get",                     # no kind
+    "kv_get:err:p=1.5",           # p out of range
+    "kv_get:err:p=0",             # p out of range
+    "kv_get:err:after=0",         # after < 1
+    "kv_get:err:times=0",         # times < 1
+    "kv_get:err:bogus=1",         # unknown param
+    "kv_get:kv_put:err",          # two sites
+    "kv_get:err:die",             # two kinds
+    "negotiate:delay",            # delay without duration
+    "dispatch:err:delay=5ms",     # kind conflict
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+def test_parse_spec_empty_rules_skipped():
+    assert parse_spec(" ; kv_get:err ; ") == parse_spec("kv_get:err")
+
+
+# ---------------------------------------------------------------------------
+# injector semantics
+# ---------------------------------------------------------------------------
+
+def _drive(inj, site, n):
+    fired = 0
+    for _ in range(n):
+        try:
+            inj.fire(site)
+        except chaos.InjectedFault:
+            fired += 1
+    return fired
+
+
+def test_injector_after_and_times():
+    inj = chaos.FaultInjector(parse_spec("s:err:after=3:times=2"))
+    outcomes = []
+    for _ in range(6):
+        try:
+            inj.fire("s")
+            outcomes.append(False)
+        except chaos.InjectedFault:
+            outcomes.append(True)
+    # eligible from traversal 3, capped at 2 fires
+    assert outcomes == [False, False, True, True, False, False]
+
+
+def test_injector_rank_filter():
+    rules = parse_spec("s:err:rank=3")
+    hit = chaos.FaultInjector(rules, rank=3)
+    miss = chaos.FaultInjector(rules, rank=1)
+    assert _drive(hit, "s", 5) == 5
+    assert _drive(miss, "s", 5) == 0
+
+
+def test_injector_site_glob_and_counter():
+    before = REGISTRY.get("hvd_faults_injected_total").total()
+    inj = chaos.FaultInjector(parse_spec("kv_*:err"))
+    assert _drive(inj, "kv_get", 2) == 2
+    assert _drive(inj, "kv_put", 1) == 1
+    assert _drive(inj, "negotiate", 4) == 0
+    assert REGISTRY.get("hvd_faults_injected_total").total() - before == 3
+
+
+def test_injector_probability_is_deterministic_per_seed():
+    spec = "s:err:p=0.3:seed=11"
+    a = chaos.FaultInjector(parse_spec(spec))
+    b = chaos.FaultInjector(parse_spec(spec))
+    fired_a = _drive(a, "s", 300)
+    fired_b = _drive(b, "s", 300)
+    assert fired_a == fired_b and 0 < fired_a < 300
+    assert a.fired_events() == b.fired_events()
+    # a different seed draws a different stream
+    c = chaos.FaultInjector(parse_spec("s:err:p=0.3:seed=12"))
+    _drive(c, "s", 300)
+    assert c.fired_events() != a.fired_events()
+
+
+def test_injector_streams_independent_across_ranks():
+    spec = parse_spec("s:err:p=0.5:seed=9")
+    r0 = chaos.FaultInjector(spec, rank=0)
+    r1 = chaos.FaultInjector(spec, rank=1)
+    _drive(r0, "s", 200)
+    _drive(r1, "s", 200)
+    assert r0.fired_events() != r1.fired_events()
+    # ...but each rank's own stream reproduces exactly
+    r1b = chaos.FaultInjector(spec, rank=1)
+    _drive(r1b, "s", 200)
+    assert r1.fired_events() == r1b.fired_events()
+
+
+def test_injector_delay_sleeps():
+    inj = chaos.FaultInjector(parse_spec("s:delay=30ms:times=1"))
+    t0 = time.monotonic()
+    inj.fire("s")
+    assert time.monotonic() - t0 >= 0.025
+    t0 = time.monotonic()
+    inj.fire("s")                       # times exhausted: no sleep
+    assert time.monotonic() - t0 < 0.02
+
+
+def test_injector_once_latch(tmp_path):
+    latch = tmp_path / "latch"
+    spec = parse_spec(f"s:err:once={latch}")
+    a = chaos.FaultInjector(spec)
+    assert _drive(a, "s", 3) == 1       # claimed on first fire
+    assert latch.exists()
+    b = chaos.FaultInjector(spec)       # "relaunched" process
+    assert _drive(b, "s", 3) == 0
+
+
+def test_arm_is_idempotent_for_same_spec():
+    a = chaos.arm("s:err:after=5")
+    chaos.fire("s")                     # traversal 1 recorded
+    b = chaos.arm("s:err:after=5")      # same spec: injector kept
+    assert b is a
+    c = chaos.arm("s:err:after=9")      # different spec: replaced
+    assert c is not a
+
+
+def test_arm_rejects_bad_spec():
+    with pytest.raises(ValueError):
+        chaos.arm("kv_get:bogus=1")
+    assert chaos.injector() is None
+
+
+def test_fire_disarmed_is_noop():
+    chaos.disarm()
+    chaos.fire("anything")              # must not raise
+
+
+def test_injected_fault_is_retryable():
+    assert retry.retryable_error(chaos.InjectedFault("x"))
+    assert issubclass(chaos.InjectedFault, ConnectionError)
+
+
+def test_fault_records_land_in_flight_ring():
+    from horovod_tpu.obs import flightrec
+    rec = flightrec.RECORDER
+    n0 = len(rec)
+    chaos.arm("s:err:times=1")
+    with pytest.raises(chaos.InjectedFault):
+        chaos.fire("s")
+    events = rec.snapshot()[-(len(rec) - n0):] if len(rec) > n0 else []
+    assert any(e["kind"] == "fault_injected"
+               and e["data"]["fault_kind"] == "err"
+               and e["name"] == "s" for e in events), events[-3:]
+
+
+# ---------------------------------------------------------------------------
+# unified retry policy
+# ---------------------------------------------------------------------------
+
+def test_retry_call_retries_then_succeeds():
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    out = retry.retry_call(flaky, op="t1",
+                           policy=retry.RetryPolicy(max_attempts=5,
+                                                    base_delay_s=0.01),
+                           sleep=sleeps.append)
+    assert out == "ok" and calls["n"] == 3 and len(sleeps) == 2
+    assert sleeps[1] > sleeps[0]        # exponential
+
+
+def test_retry_call_gives_up_after_max_attempts():
+    before = REGISTRY.get("hvd_retry_giveups_total").total()
+    with pytest.raises(ConnectionError):
+        retry.retry_call(lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                         op="t2",
+                         policy=retry.RetryPolicy(max_attempts=3,
+                                                  base_delay_s=0.0),
+                         sleep=lambda s: None)
+    assert REGISTRY.get("hvd_retry_giveups_total").total() - before == 1
+
+
+def test_retry_call_honors_overall_deadline():
+    clock = {"t": 0.0}
+
+    def sleep(s):
+        clock["t"] += s
+
+    with pytest.raises(TimeoutError):
+        retry.retry_call(
+            lambda: (_ for _ in ()).throw(TimeoutError("slow")),
+            op="t3",
+            policy=retry.RetryPolicy(max_attempts=None, deadline_s=1.0,
+                                     base_delay_s=0.3, max_delay_s=0.3,
+                                     jitter=0.0),
+            clock=lambda: clock["t"], sleep=sleep)
+    assert clock["t"] <= 1.0 + 1e-9     # never slept past the budget
+
+
+def test_retry_call_permanent_and_unclassified_surface_immediately():
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise ValueError("programming error")
+
+    with pytest.raises(ValueError):
+        retry.retry_call(bad, op="t4")
+    assert calls["n"] == 1
+
+    class Expired(retry.Permanent, TimeoutError):
+        pass
+
+    calls["n"] = 0
+
+    def expired():
+        calls["n"] += 1
+        raise Expired("budget gone")
+
+    with pytest.raises(Expired):
+        retry.retry_call(expired, op="t4")
+    assert calls["n"] == 1
+
+
+def test_retry_jitter_is_deterministic():
+    p = retry.RetryPolicy(base_delay_s=0.1, jitter=0.2, seed=3)
+    a = [p.delay_for("op", i) for i in range(1, 6)]
+    b = [p.delay_for("op", i) for i in range(1, 6)]
+    assert a == b
+    assert a != [p.delay_for("other", i) for i in range(1, 6)]
+    flat = retry.RetryPolicy(base_delay_s=0.1, jitter=0.0)
+    assert flat.delay_for("op", 1) == pytest.approx(0.1)
+    assert flat.delay_for("op", 2) == pytest.approx(0.2)
+
+
+def test_backoff_loop_helper_resets():
+    b = retry.Backoff(retry.RetryPolicy(base_delay_s=0.1, max_delay_s=0.4,
+                                        jitter=0.0), op="loop")
+    assert [round(b.next_delay(), 3) for _ in range(4)] == \
+        [0.1, 0.2, 0.4, 0.4]
+    b.reset()
+    assert b.next_delay() == pytest.approx(0.1)
+
+
+# ---------------------------------------------------------------------------
+# kv blob deadline + retry (satellite: one budget across chunk fetches)
+# ---------------------------------------------------------------------------
+
+class _FakeKV:
+    """KV double: programmable per-key behavior."""
+
+    def __init__(self, store=None, fail_every=0):
+        self.store = dict(store or {})
+        self.calls = 0
+        self.fail_every = fail_every
+
+    def _maybe_fail(self):
+        self.calls += 1
+        if self.fail_every and self.calls % self.fail_every == 0:
+            raise ConnectionError("flaky store")
+
+    def set(self, key, value):
+        self._maybe_fail()
+        self.store[key] = value
+
+    def wait(self, key, timeout_ms=1000):
+        self._maybe_fail()
+        if key in self.store:
+            return self.store[key]
+        # emulate the native client's blocking wait running out
+        time.sleep(min(timeout_ms / 1000.0, 0.02))
+        raise TimeoutError(f"no {key} within {timeout_ms}ms")
+
+
+def _blob_store(prefix, data, chunk):
+    store = {}
+    n = max(1, (len(data) + chunk - 1) // chunk)
+    for i in range(n):
+        store[f"{prefix}/{i}"] = data[i * chunk:(i + 1) * chunk]
+    store[f"{prefix}/meta"] = f"{n}:{len(data)}".encode()
+    return store
+
+
+def test_kv_get_blob_roundtrip_and_flaky_retry(monkeypatch):
+    from horovod_tpu.runner import api
+    data = bytes(range(256)) * 64
+    monkeypatch.setattr(api, "_CHUNK", 1024)
+    kv = _FakeKV(_blob_store("b", data, 1024), fail_every=3)
+    assert api.kv_get_blob(kv, "b", timeout_ms=5000) == data
+
+
+def test_kv_get_blob_one_overall_deadline(monkeypatch):
+    """A missing chunk must exhaust ONE shared budget — pre-fix, each of
+    the n chunks restarted the full timeout (n-fold overrun)."""
+    from horovod_tpu.runner import api
+    monkeypatch.setattr(api, "_CHUNK", 8)
+    data = b"x" * 64                      # 8 chunks
+    store = _blob_store("b", data, 8)
+    for i in range(2, 8):                 # chunks 2..7 never arrive
+        del store[f"b/{i}"]
+    kv = _FakeKV(store)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        api.kv_get_blob(kv, "b", timeout_ms=300)
+    took = time.monotonic() - t0
+    assert took < 1.5, f"deadline not shared across chunks: {took:.2f}s"
+
+
+def test_kv_put_blob_retries_transient_errors(monkeypatch):
+    from horovod_tpu.runner import api
+    monkeypatch.setattr(api, "_CHUNK", 16)
+    data = b"y" * 100
+    kv = _FakeKV(fail_every=4)
+    api.kv_put_blob(kv, "p", data, deadline_s=5.0)
+    got = b"".join(kv.store[f"p/{i}"] for i in range(7))
+    assert got == data
+    assert kv.store["p/meta"] == b"7:100"
+
+
+def test_kv_blob_sites_injectable(monkeypatch):
+    """Injected kv faults ride the retry path: p<1 errors are absorbed,
+    the blob still round-trips, and the fault counter moved."""
+    from horovod_tpu.runner import api
+    monkeypatch.setattr(api, "_CHUNK", 64)
+    before = REGISTRY.get("hvd_faults_injected_total").total()
+    chaos.arm("kv_put:err:p=0.2:seed=1; kv_get:err:p=0.2:seed=2")
+    try:
+        kv = _FakeKV()
+        data = b"z" * 1000
+        api.kv_put_blob(kv, "c", data, deadline_s=10.0)
+        assert api.kv_get_blob(kv, "c", timeout_ms=10000) == data
+    finally:
+        chaos.disarm()
+    assert REGISTRY.get("hvd_faults_injected_total").total() > before
+
+
+# ---------------------------------------------------------------------------
+# blacklist decay (satellite: probation instead of a life sentence)
+# ---------------------------------------------------------------------------
+
+def _driver(clock, cooldown=10.0, max_cooldown=40.0, spec="a:2,b:2"):
+    from horovod_tpu.runner.elastic import ElasticDriver, FixedDiscovery
+    return ElasticDriver(FixedDiscovery(spec), min_np=1,
+                         blacklist_cooldown_s=cooldown,
+                         blacklist_max_cooldown_s=max_cooldown,
+                         clock=lambda: clock["t"])
+
+
+def test_blacklist_decays_and_readmits_on_probation():
+    clock = {"t": 0.0}
+    d = _driver(clock)
+    d.blacklist("a")
+    assert d.blacklisted() == {"a"}
+    clock["t"] = 9.9
+    assert d.blacklisted() == {"a"}
+    clock["t"] = 10.1                    # cooldown lapsed
+    assert d.blacklisted() == set()
+    assert d.blacklist_failures("a") == 1   # probation, not amnesia
+    d.poll_hosts()
+    assert [host for _, host, _ in d.assignment()] == \
+        ["a", "a", "b", "b"]
+
+
+def test_blacklist_cooldown_doubles_per_failure_and_caps():
+    clock = {"t": 0.0}
+    d = _driver(clock, cooldown=10.0, max_cooldown=25.0)
+    d.blacklist("a")                     # cooldown 10
+    clock["t"] = 11.0
+    assert d.blacklisted() == set()
+    d.blacklist("a")                     # failure #2: cooldown 20
+    clock["t"] = 11.0 + 19.0
+    assert d.blacklisted() == {"a"}
+    clock["t"] = 11.0 + 21.0
+    assert d.blacklisted() == set()
+    d.blacklist("a")                     # failure #3: 40 -> capped 25
+    clock["t"] = 32.0 + 24.0
+    assert d.blacklisted() == {"a"}
+    clock["t"] = 32.0 + 26.0
+    assert d.blacklisted() == set()
+
+
+def test_blacklist_zero_cooldown_is_permanent():
+    clock = {"t": 0.0}
+    d = _driver(clock, cooldown=0.0)
+    d.blacklist("a")
+    clock["t"] = 1e9
+    assert d.blacklisted() == {"a"}
+
+
+def test_wait_for_slots_survives_discovery_failures():
+    from horovod_tpu.runner.elastic import ElasticDriver, HostDiscovery
+    from horovod_tpu.runner.hosts import parse_hosts
+
+    class Flaky(HostDiscovery):
+        def __init__(self):
+            self.calls = 0
+
+        def find_available_hosts(self):
+            self.calls += 1
+            if self.calls <= 2:
+                raise RuntimeError("discovery script crashed")
+            return parse_hosts("a:2")
+
+    d = ElasticDriver(Flaky(), min_np=2, poll_interval_s=0.01)
+    hosts = d.wait_for_available_slots(timeout_s=10.0)
+    assert [h.hostname for h in hosts] == ["a"]
+    assert d._discovery.calls == 3
+
+
+def test_wait_for_slots_still_times_out():
+    from horovod_tpu.runner.elastic import ElasticDriver, HostDiscovery
+
+    class Dead(HostDiscovery):
+        def find_available_hosts(self):
+            raise RuntimeError("never")
+
+    d = ElasticDriver(Dead(), min_np=1, poll_interval_s=0.01)
+    with pytest.raises(TimeoutError, match="last discovery error"):
+        d.wait_for_available_slots(timeout_s=0.2)
+
+
+# ---------------------------------------------------------------------------
+# /healthz: components + negotiation-age limit
+# ---------------------------------------------------------------------------
+
+def test_healthz_component_degrades_and_recovers():
+    from horovod_tpu.context import _health_snapshot, set_component_health
+    assert _health_snapshot()["ready"] is True
+    set_component_health("serving", False, reason="drain window")
+    try:
+        h = _health_snapshot()
+        assert h["ready"] is False
+        assert h["status"] == "degraded:serving"
+        assert h["components"]["serving"]["reason"] == "drain window"
+        set_component_health("serving", True)
+        assert _health_snapshot()["ready"] is True
+    finally:
+        set_component_health("serving", None)
+    assert "components" not in _health_snapshot()
+
+
+def test_healthz_negotiation_age_limit():
+    from horovod_tpu.context import _health_snapshot, global_state
+    cfg = global_state().config
+    old = cfg.health_max_negotiation_age_s
+    try:
+        cfg.health_max_negotiation_age_s = 1e-9
+        h = _health_snapshot()
+        assert h["ready"] is False and h["status"] == "stalled"
+        cfg.health_max_negotiation_age_s = 1e9
+        assert _health_snapshot()["ready"] is True
+    finally:
+        cfg.health_max_negotiation_age_s = old
+
+
+# ---------------------------------------------------------------------------
+# serving graceful degradation (in-process; the np=1 harness scenario
+# additionally asserts the live 200->503->200 HTTP transition)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_serving():
+    import jax
+    from horovod_tpu.models import llama
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return params, cfg
+
+
+def test_serving_abort_carries_error_finish_reason(tiny_serving):
+    from horovod_tpu import serving
+    from horovod_tpu.context import _health_snapshot
+    params, cfg = tiny_serving
+    with serving.serve(params, cfg, num_blocks=16, block_size=8,
+                       max_active=2) as sess:
+        chaos.arm("serving_step:err:after=2:times=1")
+        try:
+            f0 = sess.submit(np.arange(4, dtype=np.int32), max_tokens=8)
+            f1 = sess.submit(np.arange(3, dtype=np.int32), max_tokens=8)
+            sess.drain()
+            r0, r1 = f0.result(timeout=60), f1.result(timeout=60)
+        finally:
+            chaos.disarm()
+        # both in-flight requests finished NOW with the error reason and
+        # their partial tokens (step 1 = prefill emit + one decode tick)
+        for r in (r0, r1):
+            assert r.metrics["finish_reason"] == "error"
+            assert "injected fault" in r.metrics["error"]
+            assert 1 <= len(r.tokens) < 8
+        assert sess.recoveries == 1
+        # recovered: healthz is green again and new traffic flows
+        assert _health_snapshot()["ready"] is True
+        f2 = sess.submit(np.arange(5, dtype=np.int32), max_tokens=3)
+        sess.drain()
+        r2 = f2.result(timeout=60)
+        assert r2.metrics["finish_reason"] == "length"
+        assert len(r2.tokens) == 3
+
+
+def test_serving_finish_reasons_normal_paths(tiny_serving):
+    from horovod_tpu import serving
+    params, cfg = tiny_serving
+    with serving.serve(params, cfg, num_blocks=16, block_size=8,
+                       max_active=2) as sess:
+        f = sess.submit(np.arange(4, dtype=np.int32), max_tokens=2)
+        sess.drain()
+        assert f.result(timeout=60).metrics["finish_reason"] == "length"
+
+
+def test_serving_gives_up_after_max_recoveries(tiny_serving):
+    from horovod_tpu import serving
+    params, cfg = tiny_serving
+    from horovod_tpu.context import set_component_health
+    try:
+        with serving.serve(params, cfg, num_blocks=16, block_size=8,
+                           max_active=2, max_recoveries=0) as sess:
+            chaos.arm("serving_step:err")
+            try:
+                sess.submit(np.arange(4, dtype=np.int32), max_tokens=4)
+                with pytest.raises(chaos.InjectedFault):
+                    sess.drain()
+            finally:
+                chaos.disarm()
+    finally:
+        set_component_health("serving", None)
+
+
+def test_serving_admission_fault_rejects_before_queue(tiny_serving):
+    from horovod_tpu import serving
+    params, cfg = tiny_serving
+    with serving.serve(params, cfg, num_blocks=16, block_size=8,
+                       max_active=2) as sess:
+        chaos.arm("serving_admit:err")
+        try:
+            with pytest.raises(chaos.InjectedFault):
+                sess.submit(np.arange(4, dtype=np.int32), max_tokens=4)
+        finally:
+            chaos.disarm()
+        assert not sess.engine.has_work()

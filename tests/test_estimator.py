@@ -377,6 +377,7 @@ def test_jax_estimator_fit_against_remote_store():
 
 
 @pytest.mark.integration
+@pytest.mark.slow  # tier-1 budget (~54s) + RSS-delta flake under load; unit-and-rig runs it
 def test_streaming_fit_peak_rss_below_materialized(tmp_path):
     """The streaming promise, measured: fitting a ~400 MB parquet through
     ParquetBatches must not grow the process by anywhere near the dataset

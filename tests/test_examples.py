@@ -41,6 +41,7 @@ def test_torch_imagenet_resnet50_example():
 
 
 @pytest.mark.integration
+@pytest.mark.slow  # tier-1 budget (~28s): CI examples-smoke runs every example
 def test_tf_keras_bert_pretrain_example():
     res = _hvdrun_example(
         [os.path.join(REPO, "examples", "tf_keras_bert_pretrain.py"),

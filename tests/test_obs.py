@@ -472,6 +472,26 @@ def test_cluster_serving_trace_e2e_np2():
 
 
 @pytest.mark.integration
+@pytest.mark.slow
+def test_healthz_transitions_under_injected_faults_np2():
+    """Acceptance (chaos satellite): with a fault spec stalling rank 1's
+    negotiation check-in and then injecting a serving-step failure,
+    rank 0's /healthz must transition 200 -> 503 -> 200 twice (stall,
+    then serving drain window), the aborted request must carry
+    finish_reason="error", and rank 1's injected fault must surface
+    rank-labeled in hvd_faults_injected_total on /cluster.  slow-marked
+    (two runner startups + a tiny-llama compile); the in-process halves
+    are tier-1 in test_chaos.py."""
+    res = _hvdrun(2, extra_env={
+        "HVDTPU_TEST_MODE": "chaos",
+        "HVDTPU_HEALTH_MAX_NEGOTIATION_AGE": "1",
+    }, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "rank 0: CHAOS-OK" in res.stdout, res.stdout
+    assert "rank 1: CHAOS-STALLER-OK" in res.stdout, res.stdout
+
+
+@pytest.mark.integration
 def test_straggler_attribution_np4():
     """Acceptance: a deliberately withheld allreduce at np=4 produces a
     stall report naming the exact lagging rank and tensor."""
