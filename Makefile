@@ -15,6 +15,7 @@ horovod_tpu.serving"
 	$(PY) -m pytest tests -q -x --ignore=tests/test_runner.py
 	$(PY) -m pytest tests/test_runner.py -q -x
 	$(PY) -m horovod_tpu.chaos.run --np 4
+	$(PY) -m horovod_tpu.chaos.run --scenario router
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 # Regenerate BASELINE.md's measured table from benchmarks/measured.jsonl

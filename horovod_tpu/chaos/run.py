@@ -257,6 +257,188 @@ def scenario_serving() -> None:
 
 
 # ---------------------------------------------------------------------------
+# scenario: router failover across np=2 serving replicas
+# ---------------------------------------------------------------------------
+
+def router_worker_main(rank: int) -> int:
+    """One serving replica behind the front-door transport: session +
+    ReplicaServer + RankPublisher + /healthz endpoint, serving until the
+    parent writes ``fd/stop``.  Rank 1 carries an injected mid-stream
+    death (``serving_step:die`` via env, armed at package import)."""
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np  # noqa: F401
+
+    from .. import serving
+    from ..context import component_health
+    from ..models import llama
+    from ..obs import flightrec, server
+    from ..obs.aggregate import RankPublisher, _kv_from_env
+    from ..serving.frontdoor.transport import ReplicaServer
+
+    # No hvd.init() in this worker (single-process serving), so arm the
+    # flight recorder's dump directory from the env directly — the
+    # injected death dumps unconditionally and must not litter the cwd.
+    flightrec.RECORDER.arm(os.environ.get("HVDTPU_FLIGHT_RECORDER_DIR"))
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    sess = serving.serve(params, cfg, num_blocks=64, block_size=8,
+                         max_active=4, use_flash="never",
+                         prefix_cache=True)
+    server.set_health_provider(
+        lambda: {"ready": bool(component_health("serving")),
+                 "status": "ok", "rank": rank})
+    srv = server.MetricsServer(0, addr="127.0.0.1")
+    kv = _kv_from_env()
+    kv.set(f"fd/port/{rank}", str(srv.port).encode())
+    replica = ReplicaServer(sess, rank).start()
+    pub = RankPublisher(rank, 2, interval_s=0.5).start()
+    sess.start()
+    try:
+        while kv.get("fd/stop") is None:
+            time.sleep(0.1)
+    finally:
+        pub.stop()
+        replica.stop()
+        sess.close()
+        srv.close()
+    return 0
+
+
+def scenario_router() -> None:
+    """np=2 replicas + router; a ``serving_step:die`` kills one replica
+    mid-stream.  Asserts: every in-flight request completes on the
+    survivor token-identical to the greedy reference, the router
+    recorded failovers, ``hvd_router_replica_healthy`` and ``/healthz``
+    reflect the dead/live split, and the dead worker exited with the
+    injected ``DIE_EXIT_CODE``."""
+    import secrets
+    import subprocess
+    import urllib.error
+    import urllib.request
+
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from . import DIE_EXIT_CODE
+    from .._native import KvClient, KvServer
+    from ..models import llama
+    from ..obs import REGISTRY
+    from ..serving.frontdoor import Router, RouterConfig
+    from ..serving.frontdoor.transport import KVReplicaClient
+
+    kv_srv = KvServer(secret=os.environ.setdefault(
+        "HVDTPU_SECRET", secrets.token_hex(8)))
+    os.environ["HVDTPU_RENDEZVOUS_ADDR"] = f"127.0.0.1:{kv_srv.port}"
+    env_base = dict(os.environ)
+    env_base["PYTHONPATH"] = os.pathsep.join(
+        [p for p in (os.getcwd(),
+                     os.environ.get("PYTHONPATH", "")) if p])
+    env_base.pop("HVDTPU_FAULTS", None)
+    # The injected death dumps a flight-recorder bundle; keep it out of
+    # the caller's cwd.
+    env_base["HVDTPU_FLIGHT_RECORDER_DIR"] = \
+        tempfile.mkdtemp(prefix="hvdtpu-fd-flightrec-")
+    workers = []
+    for rank in range(2):
+        env = dict(env_base)
+        if rank == 1:
+            # Dies on its 6th serving round — mid-stream of every
+            # request placed on it (each needs ~max_tokens rounds).
+            env["HVDTPU_FAULTS"] = "serving_step:die:after=6"
+        workers.append(subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.chaos.run",
+             "--router-worker", str(rank)], env=env))
+    kv = KvClient("127.0.0.1", kv_srv.port, timeout_ms=5000)
+    try:
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            if all(kv.get(f"fd/member/{r}") is not None
+                   and kv.get(f"obs/rank/{r}/meta") is not None
+                   for r in range(2)):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("replicas never registered")
+        ports = {r: int(kv.get(f"fd/port/{r}").decode())
+                 for r in range(2)}
+
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+        def oracle(prompt, m):
+            full = np.asarray(llama.generate(
+                params, jnp.asarray(np.asarray(prompt)[None]), cfg,
+                max_new_tokens=m))[0]
+            return [int(t) for t in full[len(prompt):]]
+
+        router = Router(
+            [KVReplicaClient(r, kv) for r in range(2)],
+            RouterConfig(max_attempts=4))
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(0, 256, size=(8 + 2 * i,)).astype(np.int32)
+                   for i in range(6)]
+        futs = [router.submit(p, 16) for p in prompts]
+        router.drain(timeout_s=150.0)
+
+        for p, f in zip(prompts, futs):
+            res = f.result(timeout=5)
+            assert res.metrics["finish_reason"] == "length", res.metrics
+            assert res.tokens == oracle(p, 16), \
+                (res.tokens, oracle(p, 16))
+        assert router.failovers >= 1, \
+            "the injected death never forced a failover"
+
+        # Health gauges + /healthz reflect the dead/live split.  The
+        # gauge tracks snapshot freshness, so pump until the survivor's
+        # next publish lands (freshness is timing-dependent on a loaded
+        # CPU rig).
+        healthy = {}
+        gauge_deadline = time.monotonic() + 30.0
+        while time.monotonic() < gauge_deadline:
+            router.pump()
+            healthy = {
+                s["labels"]["replica"]: s["value"]
+                for fam in REGISTRY.snapshot()
+                if fam["name"] == "hvd_router_replica_healthy"
+                for s in fam["samples"]}
+            if healthy.get("0") == 1.0 and healthy.get("1") == 0.0:
+                break
+            time.sleep(0.1)
+        assert healthy.get("0") == 1.0, healthy
+        assert healthy.get("1") == 0.0, healthy
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{ports[0]}/healthz", timeout=5) as r:
+            assert r.status == 200
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ports[1]}/healthz", timeout=5)
+            raise AssertionError("dead replica's /healthz still answers")
+        except (urllib.error.URLError, ConnectionError, OSError):
+            pass
+
+        kv.set("fd/stop", b"1")
+        assert workers[1].wait(timeout=30) == DIE_EXIT_CODE, \
+            workers[1].returncode
+        assert workers[0].wait(timeout=30) == 0, workers[0].returncode
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+        kv.close()
+    print(f"CHAOS-ROUTER-OK np=2 failovers={router.failovers} "
+          f"(in-flight requests finished token-identical on the "
+          f"survivor)")
+
+
+# ---------------------------------------------------------------------------
 # scenario: determinism (same seed => identical fault sequence)
 # ---------------------------------------------------------------------------
 
@@ -294,13 +476,24 @@ def main(argv=None) -> int:
         description="chaos scenario harness (the chaos-recovery CI job)")
     p.add_argument("--worker", action="store_true",
                    help=argparse.SUPPRESS)   # internal np=4 worker
+    p.add_argument("--router-worker", type=int, default=None,
+                   metavar="RANK",
+                   help=argparse.SUPPRESS)   # internal router replica
     p.add_argument("--scenario", default="all",
-                   choices=("all", "elastic", "serving", "determinism"))
+                   choices=("all", "elastic", "serving", "determinism",
+                            "router"))
     p.add_argument("--np", type=int, default=4, dest="np_total")
     p.add_argument("--verbose", "-v", action="store_true")
     args = p.parse_args(argv)
     if args.worker:
         return worker_main()
+    if args.router_worker is not None:
+        return router_worker_main(args.router_worker)
+
+    if args.scenario == "router":
+        # Not in "all": needs two full serving replicas (the dedicated
+        # router-failover CI job runs it; chaos-recovery stays cheap).
+        scenario_router()
 
     if args.scenario in ("all", "elastic"):
         scenario_elastic(args.np_total, verbose=args.verbose)

@@ -59,6 +59,8 @@ KNOWN_SITES = {
     "heartbeat": "runner.launch monitor liveness pass",
     "serving_admit": "serving.engine.submit admission",
     "serving_step": "serving.engine.step (one per serving round)",
+    "router": "serving.frontdoor.router placement (one traversal per "
+              "placement decision)",
 }
 
 _DUR_RE = re.compile(r"^([0-9]*\.?[0-9]+)(ms|s|m)?$")

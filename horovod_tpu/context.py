@@ -329,6 +329,16 @@ def set_component_health(name: str, ready, **info) -> None:
             _components[name] = {"ready": bool(ready), **info}
 
 
+def component_health(name: str):
+    """One component's readiness: True/False as last reported, None when
+    the component never registered (or deregistered).  The serving
+    replica transport mirrors ``component_health("serving")`` into its
+    published readiness gauge so the router sees drain windows."""
+    with _component_lock:
+        c = _components.get(name)
+    return None if c is None else bool(c.get("ready"))
+
+
 def _health_snapshot() -> dict:
     """The ``/healthz`` payload: is this rank able to serve/train right
     now, and how fresh is its view of the job."""

@@ -87,6 +87,14 @@ def _reinitialize() -> None:
     hvd.init()
     from ..obs import aggregate
     aggregate.publish_now()
+    try:
+        # Serving replicas behind the front door re-announce themselves
+        # so the router sees them in the re-formed world (no-op when
+        # this process hosts none).
+        from ..serving.frontdoor import transport
+        transport.republish_membership()
+    except Exception:
+        pass
 
 
 def run(func: Callable[..., Any]) -> Callable[..., Any]:

@@ -1243,6 +1243,85 @@ def decode_step_paged(params, tok: jax.Array, positions: jax.Array,
     return logits, k_pool, v_pool
 
 
+def extend_step_paged(params, tok: jax.Array, positions: jax.Array,
+                      valid: jax.Array, k_pool: jax.Array,
+                      v_pool: jax.Array, tables: jax.Array,
+                      cfg: LlamaConfig, *, mesh: Optional[Mesh] = None
+                      ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Multi-token paged forward: S tokens per row in ONE dispatch.
+
+    The serving front door's verify-forward entry — it serves both
+    (a) **prefix-hit tail prefill**: a prompt whose head is already in
+    the pool (radix prefix cache) prefills only its tail while attending
+    over the cached prefix K/V, and (b) **speculative-decode verify**:
+    the target model scores ``k + 1`` positions (last accepted token +
+    k draft tokens) in one forward so the accepted prefix falls out of a
+    single logits comparison.
+
+    tok [B, S] int32; positions [B, S] absolute positions per token;
+    valid [B, S] bool — False slots (right-padding, inactive verify
+    rows) route their K/V writes to scratch block 0 so a padded slot
+    repeating a real position can never double-write a live (block,
+    offset); their logits are meaningless and must be ignored.
+    k_pool/v_pool [L, NB, BS, KV, Dh]; tables [B, n_cols] int32.
+
+    Each layer writes all S fresh K/V rows first, then attends over the
+    table's logical window with the per-token causal mask ``pool_pos <=
+    positions[b, s]`` — so token s sees the cached prefix AND the
+    earlier tokens of this same call (their K/V just landed in the
+    pool), exactly the visibility a monolithic prefill gives it.  Reads
+    go through the contiguous-gather path (GSPMD-shardable); the Pallas
+    decode kernel is single-query and does not apply here.  Returns
+    (logits [B, S, V] fp32, k_pool, v_pool) — donate the pools."""
+    from ..serving.kv_pager import gather_blocks
+
+    B, S = tok.shape
+    L, NB, BS, KV, Dh = k_pool.shape
+    scale = 1.0 / np.sqrt(cfg.head_dim)
+    rules = shard_rules(cfg, mesh)
+    T = tables.shape[1] * BS
+    h = _embed_lookup(params["embed"], tok, cfg.dtype)
+    if mesh is not None:
+        h = shd.constrain(h, ("batch", None, None), mesh, rules)
+    rope_s = _rope_tables(positions, cfg.rope_theta, cfg.head_dim)
+    mask = jnp.arange(T)[None, None, :] <= positions[:, :, None]  # [B,S,T]
+    blk = jnp.where(valid,
+                    jnp.take_along_axis(tables, positions // BS, axis=1),
+                    0)                                             # [B,S]
+    off = jnp.where(valid, positions % BS, 0)
+
+    def constrain_pool(p):
+        if mesh is None:
+            return p
+        return shd.constrain(p, (None, None, None, "kv_heads", None),
+                             mesh, rules)
+
+    def layer(carry, xs):
+        h, kp, vp = carry
+        lp, li = xs
+        x = _rmsnorm(h, lp["attn_norm"])
+        q = _rope(jnp.einsum("bsd,dhk->bshk", x, lp["wq"]), rope_s)
+        k1, v1 = _layer_kv(x, lp, rope_s)                  # [B, S, KV, Dh]
+        kp = constrain_pool(kp.at[li, blk, off].set(k1))
+        vp = constrain_pool(vp.at[li, blk, off].set(v1))
+        keys = gather_blocks(kp[li], tables)               # [B, T, KV, Dh]
+        vals = gather_blocks(vp[li], tables)
+        attn = _cached_attend(q, keys, vals, mask, scale)
+        h = h + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+        h = h + _dense_mlp(_rmsnorm(h, lp["mlp_norm"]), lp)
+        return (h, kp, vp), None
+
+    (h, k_pool, v_pool), _ = lax.scan(
+        layer, (h, k_pool, v_pool), (params["layers"], jnp.arange(L)))
+    logits = jnp.einsum("bsd,dv->bsv",
+                        _rmsnorm(h, params["final_norm"]),
+                        params["lm_head"]).astype(jnp.float32)
+    if mesh is not None:
+        logits = shd.constrain(logits, ("batch", None, "vocab"), mesh,
+                               rules)
+    return logits, k_pool, v_pool
+
+
 def _use_blockwise_ce(cfg: LlamaConfig, mesh: Optional[Mesh]) -> bool:
     if not cfg.blockwise_ce:
         return False

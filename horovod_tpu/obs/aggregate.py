@@ -110,6 +110,22 @@ def decode_snapshot_blob(blob: bytes) -> dict:
 # merge
 # ---------------------------------------------------------------------------
 
+def snapshot_is_stale(snap: dict, now: Optional[float] = None) -> bool:
+    """True when a rank snapshot's age exceeds 2x its publish interval —
+    the publisher has missed two cadences, so the rank is crashed,
+    shrunk away, or wedged.  The single staleness definition shared by
+    :func:`merge_snapshots` (cluster-sum exclusion, ``stale`` labels)
+    and the serving router (a stale replica is ineligible for new
+    placements).  The aggregator's fetch path separately hard-drops at
+    4x/10s; this is the earlier, advisory threshold."""
+    ts = snap.get("time")
+    if not ts:
+        return False
+    interval = float(snap.get("interval_s", DEFAULT_PUBLISH_INTERVAL_S))
+    age = max(0.0, (time.time() if now is None else now) - float(ts))
+    return age > 2 * interval
+
+
 def merge_snapshots(rank_snaps: list) -> list:
     """Merge per-rank snapshot envelopes into one cluster-level snapshot
     (same plain-data shape as :meth:`MetricRegistry.snapshot`, so both
@@ -164,9 +180,7 @@ def merge_snapshots(rank_snaps: list) -> list:
         size = max(size, int(snap.get("size", 0)))
         age = (max(0.0, now - float(snap["time"]))
                if snap.get("time") else 0.0)
-        interval = float(snap.get("interval_s",
-                                  DEFAULT_PUBLISH_INTERVAL_S))
-        stale = age > 2 * interval
+        stale = snapshot_is_stale(snap, now)
         n_stale += stale
         st = "true" if stale else "false"
         g_uptime.labels(rank=r, stale=st).set(

@@ -58,24 +58,44 @@ def _reduce_in_context(g, axis_name: str, op: C.ReduceOp,
     reduction-algebra's in-context form: shared block scales via
     ``pmax``, then one ``psum`` of the narrow accumulator — 2B/elem on
     the wire instead of 4 (see :mod:`ops.reduction`).  Adasum never
-    quantizes (dot-product projections amplify the error).
+    quantizes (dot-product projections amplify the error).  Under
+    ``sched_mode="decomposed"`` (``HVDTPU_SCHED_MODE`` /
+    ``HOROVOD_TPU_SCHED_MODE``) the fp32 and quant paths route through
+    :func:`ops.sched.overlap_allreduce` instead — the allreduce becomes
+    chunked reduce-scatter/allgather chains XLA can overlap with the
+    surrounding arithmetic; bf16/fp16 cast modes stay monolithic, same
+    rule as the engine resolver.
     """
     g_arr = jnp.asarray(g)
-    if routes_engine_side(compression) \
-            and op in (C.ReduceOp.AVERAGE, C.ReduceOp.SUM) \
-            and jnp.issubdtype(g_arr.dtype, jnp.floating):
-        from ..ops.reduction import in_context_allreduce
+    quant = routes_engine_side(compression)
+    if op in (C.ReduceOp.AVERAGE, C.ReduceOp.SUM) \
+            and jnp.issubdtype(g_arr.dtype, jnp.floating) \
+            and (quant or not compression.wire_mode):
         from ..context import global_state
         from .. import config as config_mod
         state = global_state()
         # Trace-time constants; dataclass defaults before init().
         cfg = state.config if state.initialized else config_mod.Config()
-        if int(g_arr.size) * g_arr.dtype.itemsize >= cfg.quant_min_bytes:
+        big = int(g_arr.size) * g_arr.dtype.itemsize >= cfg.quant_min_bytes
+        # Sub-floor leaves ride fp32, same as the engine path's resolver.
+        mode = compression.wire_mode if (quant and big) else "fp32"
+        if cfg.sched_mode == "decomposed":
+            # Same eligibility rules as the engine's resolve_schedule:
+            # only fp32 and the quant wire modes decompose (bf16/fp16
+            # cast stays monolithic — see its docstring), so the
+            # gradient allreduce inside a jitted train step chunks into
+            # reduce-scatter/allgather chains XLA can overlap.
+            from ..ops.sched import overlap_allreduce
+            return overlap_allreduce(
+                g_arr, axis_name, average=op is C.ReduceOp.AVERAGE,
+                mode=mode, chunks=cfg.sched_chunks,
+                block=cfg.quant_block_size)
+        if quant and big:
+            from ..ops.reduction import in_context_allreduce
             return in_context_allreduce(
-                g_arr, axis_name, compression.wire_mode,
+                g_arr, axis_name, mode,
                 average=op is C.ReduceOp.AVERAGE,
                 block=cfg.quant_block_size)
-        # Sub-floor leaves ride fp32, same as the engine path's resolver.
     wire, ctx = compression.compress(g)
     if op is C.ReduceOp.AVERAGE:
         red = lax.pmean(wire, axis_name)

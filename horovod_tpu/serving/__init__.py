@@ -21,6 +21,10 @@ with request-level scheduling:
 - :mod:`~horovod_tpu.serving.api` — ``serve()`` front door: ``submit()``
   futures, streaming token callbacks, per-request TTFT / queue-wait /
   tok/s metrics.
+- :mod:`~horovod_tpu.serving.frontdoor` — the production front door on
+  top of one-replica sessions: a multi-replica router over the obs
+  plane's KV-store signals, a radix prefix cache that lets shared prompt
+  prefixes skip prefill, and draft-model speculative decoding.
 
 The split follows HiCCL's policy/transport separation (arXiv:2408.05962):
 the scheduler decides *what* runs each step, the engine owns *how* it runs

@@ -313,7 +313,8 @@ def serve(params: Any, cfg, *, mesh=None,
           engine_cfg: Optional[EngineConfig] = None,
           timeline: Optional[Timeline] = None,
           recover: bool = True, max_recoveries: int = 3,
-          recovery_pause_s: float = 0.0, **engine_kw
+          recovery_pause_s: float = 0.0,
+          draft_params: Any = None, draft_cfg=None, **engine_kw
           ) -> ServingSession:
     """Build a serving session for a model.
 
@@ -331,6 +332,12 @@ def serve(params: Any, cfg, *, mesh=None,
     503 on ``/healthz`` through the drain window (``recovery_pause_s``),
     re-rendezvouses when the failure was a collective abort, and
     resumes — see :meth:`ServingSession._handle_engine_failure`.
+
+    ``prefix_cache=True`` turns on the radix prefix cache (shared prompt
+    prefixes skip prefill); ``spec_k=k`` with ``draft_params`` /
+    ``draft_cfg`` turns on draft-model speculative decoding — both from
+    :mod:`horovod_tpu.serving.frontdoor`, both token-identical to plain
+    greedy decoding.
     """
     base = engine_cfg or EngineConfig()
     if engine_kw:
@@ -349,7 +356,8 @@ def serve(params: Any, cfg, *, mesh=None,
                 timeline = state_tl
                 own_timeline = False
     engine = ServingEngine(params, cfg, engine_cfg=base, mesh=mesh,
-                           timeline=timeline)
+                           timeline=timeline, draft_params=draft_params,
+                           draft_cfg=draft_cfg)
     return ServingSession(engine, timeline=timeline,
                           own_timeline=own_timeline, recover=recover,
                           max_recoveries=max_recoveries,

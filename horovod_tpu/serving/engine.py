@@ -56,6 +56,9 @@ _m_prefill_tokens = _obs.counter(
     "hvd_serving_prefill_tokens_total", "prompt tokens prefilled")
 _m_decode_tokens = _obs.counter(
     "hvd_serving_decode_tokens_total", "tokens emitted by decode ticks")
+_m_prefill_skipped = _obs.counter(
+    "hvd_serving_prefill_skipped_tokens_total",
+    "prompt tokens NOT prefilled because a cached prefix covered them")
 
 
 def _bucket_pow2(n: int, floor: int = 1) -> int:
@@ -84,6 +87,14 @@ class EngineConfig:
     #: "auto" (Pallas paged kernel on TPU), "never" (XLA gather), or
     #: "interpret" (kernel through the Pallas interpreter — CPU testing)
     use_flash: str = "auto"
+    #: radix prefix cache (frontdoor): admissions sharing a cached
+    #: prompt prefix attach its blocks and skip prefilling them
+    prefix_cache: bool = False
+    #: cap on blocks the cache may pin (None = pool-pressure bounded)
+    prefix_cache_max_blocks: Optional[int] = None
+    #: speculative decoding: draft tokens per round (0 = off; > 0 needs
+    #: ``draft_params``/``draft_cfg`` at engine construction)
+    spec_k: int = 0
 
 
 class ServingEngine:
@@ -97,7 +108,9 @@ class ServingEngine:
 
     def __init__(self, params: Any, cfg: llama.LlamaConfig, *,
                  engine_cfg: EngineConfig = EngineConfig(),
-                 mesh=None, timeline=None) -> None:
+                 mesh=None, timeline=None,
+                 draft_params: Any = None,
+                 draft_cfg: Optional[llama.LlamaConfig] = None) -> None:
         #: Timeline-v2 sink request traces render on (one lane per
         #: request with QUEUE->PREFILL->DECODE flow arrows); None keeps
         #: traces JSON/flight-recorder-only.
@@ -128,9 +141,16 @@ class ServingEngine:
             block_size=engine_cfg.block_size, kv_heads=cfg.n_kv_heads,
             head_dim=cfg.head_dim)
         self.pager = KVPager(self.cache)
+        self.prefix_cache = None
+        if engine_cfg.prefix_cache:
+            from .frontdoor.prefix_cache import PrefixCache
+            self.prefix_cache = PrefixCache(
+                self.pager,
+                max_blocks=engine_cfg.prefix_cache_max_blocks)
         self.scheduler = Scheduler(
             self.pager, max_active=engine_cfg.max_active,
-            prefill_token_budget=engine_cfg.prefill_token_budget)
+            prefill_token_budget=engine_cfg.prefill_token_budget,
+            prefix_cache=self.prefix_cache)
 
         def fresh_pool():
             pool = jnp.zeros(self.cache.shape, cfg.dtype)
@@ -164,6 +184,17 @@ class ServingEngine:
                                 donate_argnums=(0, 1))
         self._decode = jax.jit(partial(self._decode_impl),
                                donate_argnums=(1, 2))
+        self._extend = jax.jit(partial(self._extend_impl),
+                               donate_argnums=(1, 2))
+
+        self.spec = None
+        if engine_cfg.spec_k:
+            if draft_params is None or draft_cfg is None:
+                raise ValueError(
+                    "spec_k > 0 needs draft_params and draft_cfg")
+            from .frontdoor.spec_decode import SpecDecoder
+            self.spec = SpecDecoder(self, draft_params, draft_cfg,
+                                    k=engine_cfg.spec_k)
 
     # -- jitted step bodies ---------------------------------------------
     def _prefill_impl(self, params, tokens, last_pos):
@@ -194,6 +225,15 @@ class ServingEngine:
         logits, kp, vp = llama.decode_step_paged(
             params, tok, pos, kp, vp, tables, self.cfg, mesh=self.mesh,
             use_flash=self._use_flash, interpret=self._interpret)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), kp, vp
+
+    def _extend_impl(self, params, kp, vp, tok, pos, valid, tables):
+        """Multi-token paged forward ([B, S] at arbitrary positions):
+        the prefix-hit tail prefill and the speculative verify step."""
+        jnp = self._jnp
+        logits, kp, vp = llama.extend_step_paged(
+            params, tok, pos, valid, kp, vp, tables, self.cfg,
+            mesh=self.mesh)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), kp, vp
 
     # -- public surface --------------------------------------------------
@@ -257,10 +297,12 @@ class ServingEngine:
         _m_steps.inc()
         for req in self.scheduler.admit():
             self._assign_slot(req)
-            _m_prefill_tokens.inc(int(req.prefill_tokens.shape[0]))
+            _m_prefill_tokens.inc(
+                int(req.prefill_tokens.shape[0]) - req.cached_tokens)
             emitted.append((req, self._prefill_one(req)))
         if self.scheduler.running:
-            ticked = self._decode_tick()
+            ticked = (self.spec.tick() if self.spec is not None
+                      else self._decode_tick())
             _m_decode_tokens.inc(len(ticked))
             emitted.extend(ticked)
         self._sample_gauges()
@@ -310,6 +352,8 @@ class ServingEngine:
         return n
 
     def _prefill_one(self, req: Request) -> int:
+        if req.cached_tokens > 0:
+            return self._prefill_cached(req)
         jnp = self._jnp
         toks = req.prefill_tokens
         P = int(toks.shape[0])
@@ -333,11 +377,57 @@ class ServingEngine:
             self.k_pool, self.v_pool = self._scatter(
                 self.k_pool, self.v_pool, ks, vs,
                 jnp.asarray(blocks[:nb], jnp.int32))
+            if self.spec is not None:
+                self.spec.mirror_prefill(req, padded, P)
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(toks, self.pager.table(req.req_id))
         req.close_phase("prefill")
         token = self._emit(req, int(tok[0]))
         if req.state == RequestState.RUNNING:
             # The decode phase opens once and spans every tick until the
             # terminal state (scheduler.finish/preempt closes it).
+            req.open_phase("decode")
+        return token
+
+    def _prefill_cached(self, req: Request) -> int:
+        """Prefix-hit prefill: the cached head's K/V is already in the
+        pool under the shared table head, so only the ``P - C`` tail
+        tokens run — through the multi-token extend step, attending over
+        the cached blocks via the request's table."""
+        jnp = self._jnp
+        toks = req.prefill_tokens
+        P = int(toks.shape[0])
+        C = req.cached_tokens
+        S = P - C
+        Sb = _bucket_pow2(S)
+        sp = req.open_phase("prefill", tokens=P, cached=C, bucket=Sb)
+        with sp.use():
+            req.trace.event("prefill_skip", cached_tokens=C)
+            tok2 = np.zeros((1, Sb), np.int32)
+            tok2[0, :S] = toks[C:]
+            # Padded slots repeat a valid position but carry valid=False,
+            # so their writes land in scratch block 0 and their logits
+            # are never read.
+            pos2 = np.full((1, Sb), P - 1, np.int32)
+            pos2[0, :S] = np.arange(C, P, dtype=np.int32)
+            val2 = np.zeros((1, Sb), bool)
+            val2[0, :S] = True
+            n_cols = min(_bucket_pow2(self.cache.blocks_for(P)),
+                         self.cache.num_blocks)
+            tables = self.pager.table_matrix([req.req_id], n_cols)
+            nxt, self.k_pool, self.v_pool = self._extend(
+                self.params, self.k_pool, self.v_pool,
+                jnp.asarray(tok2), jnp.asarray(pos2),
+                jnp.asarray(val2), jnp.asarray(tables))
+            if self.spec is not None:
+                self.spec.mirror_extend(tok2, pos2, val2, tables)
+        if self.prefix_cache is not None:
+            # The tail may complete further full blocks; share them too.
+            self.prefix_cache.insert(toks, self.pager.table(req.req_id))
+        _m_prefill_skipped.inc(C)
+        req.close_phase("prefill")
+        token = self._emit(req, int(nxt[0, S - 1]))
+        if req.state == RequestState.RUNNING:
             req.open_phase("decode")
         return token
 

@@ -21,12 +21,22 @@ vLLM's central idea):
 Block 0 is RESERVED as a scratch target: inactive decode slots in the
 fixed-shape step function point their table rows at it, so their masked
 garbage writes can never land in a live request's block.
+
+Blocks are REFCOUNTED so the prefix cache
+(:mod:`horovod_tpu.serving.frontdoor.prefix_cache`) can share one
+physical block across many requests: a block's count is the number of
+request tables containing it plus one if the cache holds a pin on it.
+Shared blocks are only ever *prefix* blocks — fully written at insert
+time and never rewritten (writes always land at positions past the
+shared prefix, hence in privately-owned blocks), so no copy-on-write is
+needed.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from collections import Counter
+from typing import Sequence
 
 import numpy as np
 
@@ -65,13 +75,16 @@ class PagedKVCache:
 
 
 class KVPager:
-    """Free-list block allocator with per-request block tables.
+    """Free-list block allocator with refcounted per-request block tables.
 
     Invariants (tested):
-    - a block is owned by at most one request at a time;
     - block 0 is never handed out (scratch target for masked writes);
-    - ``free_blocks + sum(len(table) for live tables) == num_blocks - 1``;
-    - double-free and foreign-free raise.
+    - per held block, ``refcount == (#tables containing it)
+      + (1 if pinned)``; a block appears at most once per table;
+    - the free list and the held set partition the usable pool:
+      ``len(held) + len(free) == num_blocks - 1``;
+    - double-free, foreign-free, double-pin and pinning/sharing a
+      non-live block raise.
     """
 
     def __init__(self, cache: PagedKVCache) -> None:
@@ -82,6 +95,8 @@ class KVPager:
         # keeps the working set of pool pages dense.
         self._free: list[int] = list(range(cache.num_blocks - 1, 0, -1))
         self._tables: dict[int, list[int]] = {}
+        self._refs: dict[int, int] = {}        # held block -> refcount
+        self._pinned: set[int] = set()         # cache-held blocks
 
     # -- queries ---------------------------------------------------------
     @property
@@ -91,6 +106,17 @@ class KVPager:
     def table(self, req_id: int) -> list[int]:
         return list(self._tables[req_id])
 
+    def refcount(self, block: int) -> int:
+        """Live references to ``block`` (0 = on the free list)."""
+        return self._refs.get(block, 0)
+
+    def is_pinned(self, block: int) -> bool:
+        return block in self._pinned
+
+    def shared_blocks(self) -> int:
+        """Blocks referenced by more than one holder (sharing gauge)."""
+        return sum(1 for r in self._refs.values() if r > 1)
+
     def num_tokens_capacity(self) -> int:
         return self.free_blocks * self.cache.block_size
 
@@ -98,16 +124,45 @@ class KVPager:
         return self.cache.blocks_for(n_tokens) <= self.free_blocks
 
     # -- allocation ------------------------------------------------------
-    def allocate(self, req_id: int, n_tokens: int) -> list[int]:
-        """Fresh table covering ``n_tokens`` for a new request."""
+    def _take(self, n: int) -> list[int]:
+        blocks = [self._free.pop() for _ in range(n)]
+        for b in blocks:
+            self._refs[b] = 1
+        return blocks
+
+    def _decref(self, block: int) -> None:
+        r = self._refs[block] - 1
+        if r:
+            self._refs[block] = r
+        else:
+            del self._refs[block]
+            self._free.append(block)
+
+    def allocate(self, req_id: int, n_tokens: int,
+                 prefix_blocks: Sequence[int] = ()) -> list[int]:
+        """Fresh table covering ``n_tokens`` for a new request.
+
+        ``prefix_blocks`` (from a prefix-cache hit) head the table as
+        shared references — their refcounts bump instead of consuming
+        free blocks; only the remainder is drawn from the free list."""
         if req_id in self._tables:
             raise ValueError(f"request {req_id} already has a table")
-        need = self.cache.blocks_for(n_tokens)
+        need = self.cache.blocks_for(n_tokens) - len(prefix_blocks)
+        if need < 0:
+            raise ValueError(
+                f"{len(prefix_blocks)} prefix blocks exceed the "
+                f"{self.cache.blocks_for(n_tokens)} needed for "
+                f"{n_tokens} tokens")
+        for b in prefix_blocks:
+            if b not in self._refs:
+                raise ValueError(f"prefix block {b} is not live")
         if need > len(self._free):
             raise OutOfBlocks(
                 f"need {need} blocks for {n_tokens} tokens, "
                 f"{len(self._free)} free")
-        blocks = [self._free.pop() for _ in range(need)]
+        for b in prefix_blocks:
+            self._refs[b] += 1
+        blocks = list(prefix_blocks) + self._take(need)
         self._tables[req_id] = blocks
         return list(blocks)
 
@@ -124,15 +179,49 @@ class KVPager:
             raise OutOfBlocks(
                 f"request {req_id} needs {need} more blocks, "
                 f"{len(self._free)} free")
-        table.extend(self._free.pop() for _ in range(need))
+        table.extend(self._take(need))
+        return list(table)
+
+    def truncate(self, req_id: int, n_tokens: int) -> list[int]:
+        """Shrink ``req_id``'s table to the blocks covering ``n_tokens``
+        positions, releasing the tail (speculative-decode rollback: the
+        blocks past the accepted prefix go back to the pool so their
+        stale rejected-token K/V can never be read through this table).
+        Returns the remaining table."""
+        table = self._tables[req_id]
+        keep = self.cache.blocks_for(n_tokens)
+        for b in table[keep:]:
+            self._decref(b)
+        del table[keep:]
         return list(table)
 
     def release(self, req_id: int) -> None:
-        """Return every block of ``req_id`` to the free list."""
+        """Drop every reference ``req_id`` holds; unshared blocks return
+        to the free list, shared/pinned ones stay with their holders."""
         blocks = self._tables.pop(req_id, None)
         if blocks is None:
             raise KeyError(f"request {req_id} holds no blocks")
-        self._free.extend(blocks)
+        for b in blocks:
+            self._decref(b)
+
+    # -- cache pins ------------------------------------------------------
+    def pin(self, block: int) -> None:
+        """Add the prefix cache's reference to a live block, keeping it
+        resident after every owning request releases."""
+        if block not in self._refs:
+            raise ValueError(f"cannot pin block {block}: not live")
+        if block in self._pinned:
+            raise ValueError(f"block {block} already pinned")
+        self._pinned.add(block)
+        self._refs[block] += 1
+
+    def unpin(self, block: int) -> None:
+        """Drop the cache's reference (eviction); the block frees once no
+        request table holds it."""
+        if block not in self._pinned:
+            raise ValueError(f"block {block} is not pinned")
+        self._pinned.discard(block)
+        self._decref(block)
 
     # -- fixed-shape table matrix for the compiled step ------------------
     def table_matrix(self, req_ids: list[int], n_cols: int) -> np.ndarray:
@@ -148,12 +237,19 @@ class KVPager:
         return out
 
     def check_invariants(self) -> None:
-        held = [b for tbl in self._tables.values() for b in tbl]
-        assert 0 not in held, "scratch block 0 leaked into a table"
+        uses = Counter(b for tbl in self._tables.values() for b in tbl)
+        for tbl in self._tables.values():
+            assert len(set(tbl)) == len(tbl), "block twice in one table"
+        for b in self._pinned:
+            uses[b] += 1
+        assert 0 not in uses, "scratch block 0 leaked into a table/pin"
         assert 0 not in self._free, "scratch block 0 leaked into free list"
-        assert len(set(held)) == len(held), "block owned twice"
-        assert len(held) + len(self._free) == self.cache.num_blocks - 1, \
-            "blocks lost or duplicated"
+        assert dict(uses) == self._refs, \
+            f"refcounts drifted: counted {dict(uses)}, stored {self._refs}"
+        assert not (set(self._free) & set(self._refs)), \
+            "block both free and held"
+        assert len(self._refs) + len(self._free) \
+            == self.cache.num_blocks - 1, "blocks lost or duplicated"
 
 
 def gather_blocks(pool, table) -> "jax.Array":  # noqa: F821

@@ -71,6 +71,10 @@ class Request:
     prefill_tokens: Optional[np.ndarray] = None
     #: current context length in the pool (prefilled + generated there)
     context_len: int = 0
+    #: prompt tokens satisfied from the prefix cache at admission (their
+    #: K/V blocks were attached shared instead of prefilled); reset on
+    #: preemption — re-admission re-matches
+    cached_tokens: int = 0
     preemptions: int = 0
     # metrics timestamps (time.monotonic)
     t_submit: float = 0.0
@@ -111,6 +115,7 @@ class Request:
                 (len(self.generated) - 1) / decode_s
                 if decode_s and len(self.generated) > 1 else None),
             "preemptions": self.preemptions,
+            "cached_tokens": self.cached_tokens,
             "finish_reason": self.finish_reason,
             "trace_id": self.trace.trace_id,
         }
@@ -148,10 +153,14 @@ class Scheduler:
 
     def __init__(self, pager: KVPager, *, max_active: int,
                  prefill_token_budget: int,
+                 prefix_cache=None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         if max_active < 1:
             raise ValueError("max_active must be >= 1")
         self.pager = pager
+        #: optional frontdoor.PrefixCache — admission matches prompts
+        #: against it and pool pressure evicts from it before preempting
+        self.prefix_cache = prefix_cache
         self.max_active = max_active
         self.prefill_token_budget = max(1, prefill_token_budget)
         self.waiting: deque[Request] = deque()
@@ -236,11 +245,27 @@ class Scheduler:
                 continue
             if admitted and n > budget:
                 break                    # budget spent; strictly FIFO
-            if not self.pager.can_allocate(n + 1):
-                break                    # no head-of-line bypass
+            # Longest cached prefix: its blocks attach shared (no
+            # prefill, no free-list draw) and only the remainder needs
+            # fresh blocks.  match() does not reserve, so the eviction
+            # valve below must protect the matched blocks.
+            cached, cached_blocks = (
+                self.prefix_cache.match(prefill)
+                if self.prefix_cache is not None else (0, []))
+            need = (self.pager.cache.blocks_for(n + 1)
+                    - len(cached_blocks))
+            if need > self.pager.free_blocks:
+                if self.prefix_cache is not None:
+                    self.prefix_cache.evict(
+                        need - self.pager.free_blocks,
+                        protect=cached_blocks)
+                if need > self.pager.free_blocks:
+                    break                # no head-of-line bypass
             self.waiting.popleft()
             req.prefill_tokens = np.asarray(prefill, np.int32)
-            self.pager.allocate(req.req_id, n + 1)
+            self.pager.allocate(req.req_id, n + 1,
+                                prefix_blocks=cached_blocks)
+            req.cached_tokens = cached
             req.context_len = n
             req.state = RequestState.RUNNING
             req.t_admitted = req.t_admitted or self._clock()
@@ -271,14 +296,22 @@ class Scheduler:
         self.pager.release(req.req_id)
         self._fail_terminal(req, exc)
 
-    def grow(self, req: Request) -> None:
-        """Reserve pool space for ``req``'s next position, preempting the
-        youngest OTHER running request until the allocation fits."""
+    def grow(self, req: Request, n: int = 1) -> None:
+        """Reserve pool space for ``req``'s next ``n`` positions (one
+        decode tick, or a whole speculative round), evicting cold cached
+        prefixes and then preempting the youngest OTHER running request
+        until the allocation fits."""
         while True:
             try:
-                self.pager.extend(req.req_id, req.context_len + 1)
+                self.pager.extend(req.req_id, req.context_len + n)
                 return
             except OutOfBlocks:
+                # Pressure valve order: dropping a refcount-1 cached
+                # block loses a possible future prefill skip; preempting
+                # loses certain already-done work.  Cache first.
+                if self.prefix_cache is not None \
+                        and self.prefix_cache.evict(1):
+                    continue
                 victim = self._youngest_other(req)
                 if victim is None:
                     raise OutOfBlocks(
@@ -296,6 +329,7 @@ class Scheduler:
         req.prefill_tokens = np.concatenate(
             [req.prompt, np.asarray(req.generated, np.int32)])
         req.context_len = 0
+        req.cached_tokens = 0            # re-admission re-matches
         req.state = RequestState.WAITING
         req.preemptions += 1
         _m_preemptions.inc()

@@ -69,6 +69,54 @@ def test_fp16_compression_roundtrip():
     np.testing.assert_allclose(got, np.full((N, 3), -3.5), rtol=1e-2)
 
 
+def test_update_decomposed_schedule_parity():
+    """sched_mode=decomposed routes the in-step gradient allreduce
+    through ops.sched.overlap_allreduce; fp32 updates must be
+    bit-identical to the monolithic psum path."""
+    state = hvd.global_state()
+    cfg = state.config
+    params = {"w": jnp.zeros((3000,), jnp.float32)}
+    grads = hvd.per_rank(
+        [np.random.RandomState(r).randn(3000).astype(np.float32)
+         for r in range(N)])
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0))
+    base = hvd.to_numpy(_mapped_update(tx, {"w": grads}, params)["w"])
+    old = (cfg.sched_mode, cfg.sched_chunks)
+    cfg.sched_mode, cfg.sched_chunks = "decomposed", 3
+    try:
+        got = hvd.to_numpy(_mapped_update(tx, {"w": grads}, params)["w"])
+    finally:
+        cfg.sched_mode, cfg.sched_chunks = old
+    assert np.array_equal(got, base)
+
+
+def test_update_decomposed_quant_within_bound():
+    """Decomposed + int8 wire: the update stays inside the documented
+    shared-scale quantization bound of the exact mean (the decomposed
+    form re-quantizes the combined shard before the allgather, so it is
+    close to — not bit-equal to — the monolithic quant path)."""
+    state = hvd.global_state()
+    cfg = state.config
+    old = (cfg.sched_mode, cfg.sched_chunks, cfg.quant_min_bytes)
+    g_np = np.stack([np.random.RandomState(100 + r).randn(4096)
+                     .astype(np.float32) for r in range(N)])
+    params = {"w": jnp.zeros((4096,), jnp.float32)}
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0),
+                                  compression=Compression.int8)
+    cfg.sched_mode, cfg.sched_chunks = "decomposed", 2
+    cfg.quant_min_bytes = 1024
+    try:
+        got = hvd.to_numpy(
+            _mapped_update(tx, {"w": hvd.per_rank(list(g_np))},
+                           params)["w"])
+    finally:
+        (cfg.sched_mode, cfg.sched_chunks,
+         cfg.quant_min_bytes) = old
+    exact = -g_np.mean(0)                       # sgd lr=1 update
+    gmax = np.abs(g_np).max()
+    assert np.abs(got - exact).max() <= 1.5 * (N + 1) * gmax / 254.0
+
+
 def test_backward_passes_per_step_accumulates():
     # With N_agg=3: two zero-update calls, then one averaged step.
     n_agg = 3

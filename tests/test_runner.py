@@ -489,3 +489,25 @@ def test_chaos_recovery_scenario_harness():
             capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
         assert res.returncode == 0, res.stdout + res.stderr
         assert "CHAOS-OK" in res.stdout, res.stdout
+
+
+@pytest.mark.integration
+@pytest.mark.slow
+def test_router_failover_scenario_harness():
+    """Acceptance (the router-failover CI job, wrapped): two serving
+    replicas behind the front-door router, an injected serving_step
+    death kills one mid-stream, and every in-flight request completes
+    token-identical on the survivor while /healthz and the router
+    health gauge flip.  slow-marked: two full serving-worker
+    startups."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("HVDTPU_FAULTS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.chaos.run",
+         "--scenario", "router"],
+        capture_output=True, text=True, timeout=480, env=env, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "CHAOS-ROUTER-OK" in res.stdout, res.stdout
+    assert "CHAOS-OK" in res.stdout, res.stdout
