@@ -4,7 +4,13 @@
 
 PY ?= python
 
-.PHONY: ci native test mp-test examples bench baseline-table image
+.PHONY: ci native test mp-test examples bench baseline-table image \
+	autoscale-recovery
+
+# The autoscale-recovery CI job standalone: np=4 MoE job, injected rank
+# death + SLO load spike => shrink to np=2, grow back to np=4.
+autoscale-recovery:
+	$(PY) -m horovod_tpu.chaos.run --scenario autoscale
 
 ci: native
 	$(PY) -c "import horovod_tpu, horovod_tpu.torch, horovod_tpu.tensorflow, \
@@ -16,6 +22,7 @@ horovod_tpu.serving"
 	$(PY) -m pytest tests/test_runner.py -q -x
 	$(PY) -m horovod_tpu.chaos.run --np 4
 	$(PY) -m horovod_tpu.chaos.run --scenario router
+	$(PY) -m horovod_tpu.chaos.run --scenario autoscale
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 # Regenerate BASELINE.md's measured table from benchmarks/measured.jsonl

@@ -158,6 +158,7 @@ def build_table() -> str:
             ("seq_len", "B", "H", "D", "dtype", "causal")),
         "allreduce_busbw_sweep_cpu8": _busbw_row,
         "allreduce_busbw_sweep_cpu8_hierarchical": _busbw_row,
+        "alltoall_busbw_sweep_cpu8": _busbw_row,
     }
     for name in sorted(families):
         recs = families[name]

@@ -144,10 +144,60 @@ def allreduce_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
     return row
 
 
+def alltoall_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
+                   dtype="float32") -> dict:
+    """One uniform-alltoall bandwidth point on the current global mesh.
+
+    The MoE dispatch/combine verb (parallel/moe.py routes tokens through
+    exactly this path).  Each rank scatters ``1/N`` of its payload to
+    every peer, so the per-device wire traffic is ``(N-1)/N * bytes`` —
+    the allgather accounting, not the allreduce one.
+    """
+    import horovod_tpu as hvd
+
+    n = hvd.size()
+    itemsize = np.dtype(dtype).itemsize
+    # Rows must split evenly across ranks; round the element count up to
+    # a multiple of n so every size lands on the uniform fast path.
+    numel = max(n, -(-(nbytes // itemsize) // n) * n)
+    x = hvd.per_rank_from_fn(
+        lambda r: np.full((numel,), float(r + 1), dtype))
+
+    def one():
+        return hvd.alltoall(x)
+
+    out = one()
+    _fence(out)
+    for _ in range(warmup):
+        out = one()
+    _fence(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = one()
+    _fence(out)
+    dt = (time.perf_counter() - t0) / iters
+    payload = numel * itemsize
+    algbw = payload / dt
+    row = {"op": "alltoall", "bytes": payload, "time_us": dt * 1e6,
+           "algbw_GBs": algbw / 1e9, "ranks": n}
+    if n > 1:
+        row["busbw_GBs"] = algbw * ((n - 1) / n) / 1e9
+        row["dispatch_GBs"] = algbw / 1e9
+    else:
+        # One rank's alltoall is an identity copy — dispatch only.
+        row["dispatch_GBs"] = algbw / 1e9
+    return row
+
+
 def sweep(sizes=None, modes=("fp32",), schedules=("monolithic",),
-          **kw) -> list[dict]:
+          verb="allreduce", **kw) -> list[dict]:
     if sizes is None:
         sizes = [1 << p for p in range(12, 27, 2)]   # 4 KB .. 64 MB
+    if verb == "alltoall":
+        # Wire modes / schedules are allreduce machinery (quantized
+        # reductions, rs_ag decomposition) — the alltoall sweep is plain
+        # sizes x ranks.
+        return [alltoall_busbw(s, **kw) for s in sizes]
     return [allreduce_busbw(s, wire_precision=m, schedule=sc, **kw)
             for sc in schedules for m in modes for s in sizes]
 
@@ -172,6 +222,11 @@ def main() -> None:
     ap.add_argument("--out", default=None, metavar="PATH",
                     help="write the schedule-sweep summary as a JSON "
                     "record (BENCH_rXX.json shape)")
+    ap.add_argument("--verb", default="allreduce",
+                    choices=("allreduce", "alltoall"),
+                    help="collective to sweep; alltoall is the MoE "
+                    "dispatch/combine verb and ignores wire-precision/"
+                    "schedule (those are reduction machinery)")
     args = ap.parse_args()
     if args.cpu_devices:
         from horovod_tpu.utils.cpurig import force_cpu_platform
@@ -183,10 +238,22 @@ def main() -> None:
     hvd.global_state().config.quant_min_bytes = 0
     modes = [m.strip() for m in args.wire_precision.split(",") if m.strip()]
     schedules = [s.strip() for s in args.schedule.split(",") if s.strip()]
-    rows = sweep(modes=modes, schedules=schedules)
+    rows = sweep(modes=modes, schedules=schedules, verb=args.verb)
     for r in rows:
         print(json.dumps(r))
     key = "busbw_GBs" if "busbw_GBs" in rows[0] else "dispatch_GBs"
+    if args.verb == "alltoall":
+        best = max(rows, key=lambda r: r[key])
+        metric = ("alltoall_busbw_peak" if key == "busbw_GBs"
+                  else "alltoall_dispatch_peak")
+        print(json.dumps({"metric": metric, "value": round(best[key], 2),
+                          "unit": "GB/s", "at_bytes": best["bytes"],
+                          "ranks": best["ranks"]}))
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump({"schedule_sweep": [], "rows": rows}, fh,
+                          indent=1)
+        return
     by_mode = {m: [r for r in rows if r["wire_precision"] == m]
                for m in modes}
     base_rows = by_mode.get("fp32") or rows

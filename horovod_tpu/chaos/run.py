@@ -102,6 +102,94 @@ def worker_main() -> int:
 
 
 # ---------------------------------------------------------------------------
+# expert-parallel MoE worker (internal entry point for --scenario autoscale)
+# ---------------------------------------------------------------------------
+
+_MOE_TOTAL_STEPS = 150
+
+
+def moe_worker_main() -> int:
+    """Like :func:`worker_main` but each step drives the expert-parallel
+    MoE layer (`hvd.alltoall` dispatch/combine) plus the
+    allreduce-of-ones correctness probe.  Expert weights are sliced from
+    a deterministic full table by rank, so any world size n with
+    ``E_total % n == 0`` computes with the same experts — the state the
+    autoscale resizes must carry across exactly."""
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+    import horovod_tpu as hvd
+    import horovod_tpu.elastic as hvd_elastic
+    from horovod_tpu.elastic import FileBackedState
+    from horovod_tpu.parallel.moe import moe_layer_hvd
+
+    state_path = os.environ["HVDTPU_CHAOS_STATE"]
+    log_path = os.environ["HVDTPU_CHAOS_LOG"]
+    total = int(os.environ.get("HVDTPU_CHAOS_TOTAL",
+                               str(_MOE_TOTAL_STEPS)))
+
+    def log_line(text: str) -> None:
+        with open(log_path, "a") as f:
+            f.write(text + "\n")
+
+    hvd.init()
+    me, n = hvd.rank(), hvd.size()
+    log_line(f"START rank={me} size={n}")
+
+    D, E_total, T = 8, 4, 16
+    rng = np.random.RandomState(0)
+    router_kernel = rng.randn(D, E_total).astype(np.float32)
+    w_full = rng.randn(E_total, D, D).astype(np.float32)
+    e_local = E_total // n
+    my_experts = jnp.asarray(w_full[me * e_local:(me + 1) * e_local])
+
+    def expert_fn(w, x):
+        return jnp.tanh(x @ w)
+
+    state = FileBackedState(state_path, step=0)
+    log_line(f"RESUME rank={me} size={n} resume_step={state.step}")
+
+    @hvd_elastic.run
+    def train(state):
+        for step in range(state.step, total):
+            toks = np.random.RandomState(1000 * me + step).randn(
+                T, D).astype(np.float32)
+            outs, aux, _ = moe_layer_hvd(
+                [toks], router_kernel, expert_fn, [my_experts],
+                capacity_factor=1.25, layer="chaos")
+            out = np.asarray(outs[0])
+            if out.shape != (T, D) or not np.all(np.isfinite(out)) \
+                    or not np.isfinite(aux):
+                log_line(f"BAD rank={me} step={step} moe shape="
+                         f"{out.shape} aux={aux}")
+                raise SystemExit(3)
+            x = hvd.from_local(np.ones((1, 2), np.float32))
+            got = float(np.ravel(hvd.to_numpy(hvd.synchronize(
+                hvd.allreduce_async(x, hvd.Sum,
+                                    name=f"chaos.moe.{step}"))))[0])
+            if got != float(n):
+                log_line(f"BAD rank={me} step={step} got={got} "
+                         f"want={n}")
+                raise SystemExit(3)
+            state.step = step + 1
+            state.commit()
+            log_line(f"STEP rank={me} size={n} step={step}")
+            # Pace the loop so the np=2 stretch outlives the blacklist
+            # cooldown + controller tick + epoch bump round-trip.
+            time.sleep(0.2)
+        return state.step
+
+    train(state)
+    log_line(f"DONE rank={me} size={n} step={state.step}")
+    hvd.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # scenario: elastic recovery at np=4
 # ---------------------------------------------------------------------------
 
@@ -180,6 +268,113 @@ def scenario_elastic(np_total: int = 4, verbose: bool = False) -> None:
                for e in b["events"]), b["events"][-5:]
     print(f"CHAOS-ELASTIC-OK np={np_total} rounds="
           f"{sum(1 for ln in lines if ln.startswith('START rank=0'))} "
+          f"wall={dt:.0f}s")
+
+
+# ---------------------------------------------------------------------------
+# scenario: autoscale closed loop (shrink on preemption, grow back)
+# ---------------------------------------------------------------------------
+
+def scenario_autoscale(verbose: bool = False) -> None:
+    """np=4 expert-parallel MoE job under the closed-loop autoscaler:
+    an injected rank death blacklists its host (shrink to np=2, recorded
+    by the controller), an SLO load spike (every cycle violates a 1 µs
+    objective, so the burn rate pegs on BOTH windows) holds scale-up
+    pressure, and when the blacklist cooldown lapses the controller
+    grows the job back to np=4 through the membership-epoch bump.
+    Asserts exact state continuity across both resizes (monotone
+    resume_step, allreduce-of-ones == world size every step) and that
+    every decision surfaced as ``hvd_autoscale_*`` metrics +
+    flight-recorder events in the driver process."""
+    from ..autoscale import PolicyConfig
+    from ..obs import REGISTRY
+    from ..obs import flightrec
+    from ..runner.elastic import ElasticDriver, FixedDiscovery
+
+    work = tempfile.mkdtemp(prefix="hvdtpu_chaos_as_")
+    state_path = os.path.join(work, "state.json")
+    log_path = os.path.join(work, "train.log")
+    frec_dir = os.path.join(work, "flightrec")
+    die_latch = os.path.join(work, "die.latch")
+
+    env = {
+        # Death lands a few MoE steps in (each step is ~7 engine
+        # dispatches + the init broadcasts); the once-latch keeps the
+        # relaunched incarnations alive.
+        "HVDTPU_FAULTS": f"dispatch:rank=1:die:after=24:once={die_latch}",
+        "HVDTPU_CHAOS_STATE": state_path,
+        "HVDTPU_CHAOS_LOG": log_path,
+        "HVDTPU_CHAOS_TOTAL": str(_MOE_TOTAL_STEPS),
+        "HVDTPU_FLIGHT_RECORDER_DIR": frec_dir,
+        # The load spike: any activity violates a 1 us cycle objective,
+        # pegging hvd_slo_burn_rate on both the 5m and 1h windows — the
+        # policy's AND-gate sees sustained pressure the whole run.
+        "HVDTPU_SLO": "p99(cycle) < 1us",
+        "HVDTPU_SLO_TICK_SECONDS": "0.5",
+        "PYTHONPATH": os.pathsep.join(
+            [p for p in (os.getcwd(),
+                         os.environ.get("PYTHONPATH", "")) if p]),
+    }
+    # Short cooldown: the dead rank's host comes back ~12s after the
+    # blacklist, which is when the grow leg of the loop can fire.
+    driver = ElasticDriver(
+        FixedDiscovery("localhost:2,127.0.0.1:2"),
+        min_np=2, max_np=4, blacklist_cooldown_s=12.0)
+    policy = PolicyConfig(
+        min_np=2, max_np=4,
+        burn_threshold=1.0,
+        scale_up_cooldown_s=1.0,      # re-bump fast if one is absorbed
+        scale_down_cooldown_s=600.0,  # never shrink voluntarily here
+        stale_after_s=15.0)
+    cmd = [sys.executable, "-m", "horovod_tpu.chaos.run", "--moe-worker"]
+    t0 = time.monotonic()
+    code = driver.run_job(cmd, extra_env=env, max_restarts=5,
+                          slot_timeout_s=60.0,
+                          autoscale=policy, autoscale_interval_s=0.5,
+                          launch_kwargs={"verbose": verbose,
+                                         "connectivity_check": False})
+    dt = time.monotonic() - t0
+    assert code == 0, f"autoscale chaos job failed with exit code {code}"
+    assert dt < ELASTIC_BUDGET_S, \
+        f"recovery not bounded: took {dt:.0f}s > {ELASTIC_BUDGET_S:.0f}s"
+    assert os.path.exists(die_latch), "injected death never fired"
+
+    lines = open(log_path).read().splitlines()
+    assert not any(ln.startswith("BAD") for ln in lines), \
+        [ln for ln in lines if ln.startswith("BAD")]
+    assert "START rank=0 size=4" in lines, lines
+    # Shrink leg: relaunched at np=2 resuming from a committed step.
+    shrunk = [int(ln.split("resume_step=")[1]) for ln in lines
+              if ln.startswith("RESUME rank=0 size=2 ")]
+    assert shrunk and all(s > 0 for s in shrunk), \
+        "no np=2 resume:\n" + "\n".join(lines)
+    # Grow leg: back at np=4, resuming strictly later — exact state
+    # continuity across both resizes.
+    regrown = [int(ln.split("resume_step=")[1]) for ln in lines
+               if ln.startswith("RESUME rank=0 size=4 ")
+               and int(ln.split("resume_step=")[1]) > 0]
+    assert regrown, "never grew back to np=4:\n" + "\n".join(lines)
+    assert min(regrown) > min(shrunk), (shrunk, regrown)
+    assert any(ln.startswith(f"DONE rank=0 size=4 "
+                             f"step={_MOE_TOTAL_STEPS}")
+               for ln in lines), lines
+    assert json.load(open(state_path))["step"] == _MOE_TOTAL_STEPS
+
+    # Driver-process telemetry: the whole loop is on the record.
+    snap = {f["name"]: f for f in REGISTRY.snapshot()}
+    decisions = {s["labels"]["action"]: s["value"]
+                 for s in snap["hvd_autoscale_decisions_total"]["samples"]}
+    assert decisions.get("shrink", 0) >= 1, decisions
+    assert decisions.get("grow", 0) >= 1, decisions
+    assert snap["hvd_autoscale_target_np"]["samples"][0]["value"] == 4.0, \
+        snap["hvd_autoscale_target_np"]["samples"]
+    assert snap["hvd_autoscale_rendezvous_bumps_total"]["samples"][0][
+        "value"] >= 1
+    frec_events = [e for e in flightrec.RECORDER.snapshot()
+                   if e.get("kind") == "autoscale_decision"]
+    actions = {e.get("name") for e in frec_events}
+    assert {"shrink", "grow"} <= actions, actions
+    print(f"CHAOS-AUTOSCALE-OK 4->2->4 decisions={decisions} "
           f"wall={dt:.0f}s")
 
 
@@ -476,17 +671,21 @@ def main(argv=None) -> int:
         description="chaos scenario harness (the chaos-recovery CI job)")
     p.add_argument("--worker", action="store_true",
                    help=argparse.SUPPRESS)   # internal np=4 worker
+    p.add_argument("--moe-worker", action="store_true",
+                   help=argparse.SUPPRESS)   # internal MoE worker
     p.add_argument("--router-worker", type=int, default=None,
                    metavar="RANK",
                    help=argparse.SUPPRESS)   # internal router replica
     p.add_argument("--scenario", default="all",
                    choices=("all", "elastic", "serving", "determinism",
-                            "router"))
+                            "router", "autoscale"))
     p.add_argument("--np", type=int, default=4, dest="np_total")
     p.add_argument("--verbose", "-v", action="store_true")
     args = p.parse_args(argv)
     if args.worker:
         return worker_main()
+    if args.moe_worker:
+        return moe_worker_main()
     if args.router_worker is not None:
         return router_worker_main(args.router_worker)
 
@@ -494,6 +693,11 @@ def main(argv=None) -> int:
         # Not in "all": needs two full serving replicas (the dedicated
         # router-failover CI job runs it; chaos-recovery stays cheap).
         scenario_router()
+
+    if args.scenario == "autoscale":
+        # Not in "all": a full 4->2->4 resize circle with real cooldowns
+        # takes ~1-2 min (the dedicated autoscale-recovery CI job).
+        scenario_autoscale(verbose=args.verbose)
 
     if args.scenario in ("all", "elastic"):
         scenario_elastic(args.np_total, verbose=args.verbose)

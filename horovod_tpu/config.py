@@ -182,6 +182,24 @@ class Config:
     # --- elastic († runner/elastic) ---
     elastic: bool = False
 
+    # --- autoscaling (autoscale/, elastic mode only) ---
+    # Closed-loop controller on the elastic driver: polls /cluster
+    # signals (engine queue depth, straggler gauges, SLO burn rates) and
+    # grows/shrinks the job through elastic rendezvous.
+    autoscale: bool = False
+    autoscale_interval_s: float = 2.0
+    # Hysteresis band on the max per-rank engine queue depth: >= high
+    # is scale-up pressure, <= low is idle, between them nothing moves.
+    autoscale_queue_high: float = 8.0
+    autoscale_queue_low: float = 1.0
+    # SLO burn-rate gate: grow only when burn > threshold on BOTH the
+    # fast (5m) and slow (1h) windows (multi-window SRE alerting).
+    autoscale_burn_threshold: float = 1.0
+    autoscale_up_cooldown_s: float = 30.0
+    autoscale_down_cooldown_s: float = 120.0
+    # Freshest rank snapshot older than this => signals frozen, hold.
+    autoscale_stale_s: float = 10.0
+
     # --- coordination / rendezvous († gloo_context.cc reads of env) ---
     coordinator_addr: Optional[str] = None  # host:port of JAX coordination svc
     controller_addr: Optional[str] = None   # host:port of native coordinator
@@ -240,6 +258,14 @@ _ENV_TABLE = [
     ("hierarchical_allgather", "HIERARCHICAL_ALLGATHER", _parse_bool),
     ("hierarchical_local_size", "HIERARCHICAL_LOCAL_SIZE", int),
     ("elastic", "ELASTIC", _parse_bool),
+    ("autoscale", "AUTOSCALE", _parse_bool),
+    ("autoscale_interval_s", "AUTOSCALE_INTERVAL_SECONDS", float),
+    ("autoscale_queue_high", "AUTOSCALE_QUEUE_HIGH", float),
+    ("autoscale_queue_low", "AUTOSCALE_QUEUE_LOW", float),
+    ("autoscale_burn_threshold", "AUTOSCALE_BURN_THRESHOLD", float),
+    ("autoscale_up_cooldown_s", "AUTOSCALE_UP_COOLDOWN_SECONDS", float),
+    ("autoscale_down_cooldown_s", "AUTOSCALE_DOWN_COOLDOWN_SECONDS", float),
+    ("autoscale_stale_s", "AUTOSCALE_STALE_SECONDS", float),
     ("platform", "PLATFORM", _parse_platform),
     ("coordinator_addr", "COORDINATOR_ADDR", str),
     ("controller_addr", "CONTROLLER_ADDR", str),

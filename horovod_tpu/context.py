@@ -32,6 +32,7 @@ XLA's ICI/DCN collectives replace the reference's NCCL/MPI split.
 from __future__ import annotations
 
 import atexit
+import os
 import threading
 import time
 from typing import Optional, Sequence
@@ -286,6 +287,22 @@ def _arm_obs_plane() -> None:
     g.labels(version=version, rank=str(jax.process_index()),
              size=str(jax.process_count()),
              device_kind=getattr(dev, "device_kind", dev.platform)).set(1)
+    # Elastic world-size gauges, refreshed on every (re-)rendezvous:
+    # current_np is this epoch's actual world; target_np is what the
+    # autoscaler asked for (the driver passes it down per launch) — the
+    # two diverging on a scrape means a resize is in flight.
+    obs_registry.gauge(
+        "hvd_elastic_current_np",
+        "world size of the running assignment").set(jax.process_count())
+    _target = os.environ.get("HVDTPU_AUTOSCALE_TARGET_NP")
+    if _target:
+        try:
+            obs_registry.gauge(
+                "hvd_autoscale_target_np",
+                "world size the autoscale policy currently wants",
+            ).set(int(_target))
+        except ValueError:
+            pass
     obs_aggregate.start_for_rank(jax.process_index(), jax.process_count())
 
     # Request tracing: the config knob is the authoritative sample rate

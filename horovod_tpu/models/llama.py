@@ -481,7 +481,7 @@ def _moe_mlp(h2, lp, cfg: LlamaConfig, mesh: Optional[Mesh]):
         E = cfg.n_experts
         cap = max(1, int(flat.shape[0] * cfg.capacity_factor / E))
         logits = flat.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
-        dispatch, combine, aux = switch_route(logits, cap)
+        dispatch, combine, aux, _drops = switch_route(logits, cap)
         einputs = jnp.einsum("tec,td->ecd", dispatch.astype(flat.dtype), flat)
         eouts = jax.vmap(expert_fn)(eparams, einputs)
         out = jnp.einsum("tec,ecd->td", combine.astype(flat.dtype), eouts)

@@ -25,13 +25,38 @@ from jax import lax
 from ..jaxcompat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..obs import REGISTRY as _obs
+
+_m_dropped = _obs.counter(
+    "hvd_moe_dropped_tokens_total",
+    "tokens dropped past expert capacity (the capacity-factor tuning "
+    "signal: a persistently nonzero rate means the factor is too low "
+    "for the observed routing skew)", ("layer",))
+
+
+def record_dropped_tokens(count, layer: str = "0") -> None:
+    """Count capacity overflow drops into the per-layer counter.
+
+    Host-side (counters are process state, not traced values): callers
+    inside jit return the drop count as an output and record it here
+    after the step.
+    """
+    c = float(count)
+    if c > 0:
+        _m_dropped.labels(layer=str(layer)).inc(c)
+
 
 def switch_route(router_logits: jax.Array, capacity: int
-                 ) -> tuple[jax.Array, jax.Array, jax.Array]:
+                 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Top-1 routing masks.
 
     router_logits: [T, E].  Returns (dispatch [T, E, C] float, combine
-    [T, E, C] float, aux_loss scalar).
+    [T, E, C] float, aux_loss scalar, dropped [T] bool).
+
+    ``dropped`` marks tokens past their expert's capacity explicitly —
+    they contribute nothing to dispatch/combine (the residual recovers
+    them), but silent drops made capacity-factor tuning blind; callers
+    feed ``dropped.sum()`` to :func:`record_dropped_tokens`.
     """
     T, E = router_logits.shape
     probs = jax.nn.softmax(router_logits, axis=-1)
@@ -48,7 +73,8 @@ def switch_route(router_logits: jax.Array, capacity: int
     dispatch = keep[..., None] * pos_onehot                  # [T, E, C]
     gate = (probs * expert_onehot).sum(axis=-1)              # [T]
     combine = dispatch * gate[:, None, None]
-    return dispatch.astype(router_logits.dtype), combine, aux_loss
+    dropped = ~keep.any(axis=-1)                             # [T]
+    return dispatch.astype(router_logits.dtype), combine, aux_loss, dropped
 
 
 def moe_layer_local(tokens: jax.Array,
@@ -59,12 +85,16 @@ def moe_layer_local(tokens: jax.Array,
                     capacity_factor: float = 1.25,
                     buffer_constraint: Callable[[jax.Array], jax.Array]
                     = lambda x: x,
-                    ) -> tuple[jax.Array, jax.Array]:
+                    return_drops: bool = False,
+                    ):
     """MoE layer inside a mapped context.
 
     tokens: local [T, D]; router_kernel: [D, E_total] replicated;
     expert_params: this device's experts, leaves [E_local, ...].
-    Returns (output [T, D], aux_loss scalar).
+    Returns (output [T, D], aux_loss scalar); with ``return_drops``,
+    (output, aux_loss, dropped-token count scalar) — the count is a
+    traced value, so jitted callers thread it out and feed
+    :func:`record_dropped_tokens` host-side.
 
     ``buffer_constraint`` pins the expert buffers' sharding on the mesh
     axes that stay automatic inside the caller's ``shard_map`` (the token
@@ -82,7 +112,7 @@ def moe_layer_local(tokens: jax.Array,
     capacity = max(1, int(T * capacity_factor / E_total))
 
     logits = tokens @ router_kernel                           # [T, E]
-    dispatch, combine, aux = switch_route(logits, capacity)
+    dispatch, combine, aux, dropped = switch_route(logits, capacity)
 
     # Gather tokens into expert buffers: [E, C, D].
     expert_inputs = buffer_constraint(
@@ -105,6 +135,9 @@ def moe_layer_local(tokens: jax.Array,
     # returned: [n(expert-owner), E_local, C, D] == my tokens' results.
     results = buffer_constraint(returned.reshape(E_total, capacity, D))
     out = jnp.einsum("tec,ecd->td", combine, results)
+    if return_drops:
+        return (out.astype(tokens.dtype), aux,
+                jnp.sum(dropped.astype(jnp.float32)))
     return out.astype(tokens.dtype), aux
 
 
@@ -112,20 +145,150 @@ def moe_layer(tokens: jax.Array, router_kernel: jax.Array,
               expert_fn: Callable[[Any, jax.Array], jax.Array],
               stacked_expert_params: Any, mesh: Mesh, *,
               axis_name: str = "ep",
-              capacity_factor: float = 1.25) -> tuple[jax.Array, jax.Array]:
+              capacity_factor: float = 1.25,
+              layer: str = "0") -> tuple[jax.Array, jax.Array]:
     """Standalone entry: tokens [T, D] sharded over ``axis_name`` on dim 0;
-    expert params leaves [E_total, ...] sharded over ``axis_name``."""
+    expert params leaves [E_total, ...] sharded over ``axis_name``.
+
+    Capacity overflow drops are counted into
+    ``hvd_moe_dropped_tokens_total{layer}`` after the step (the count
+    rides out of the jitted region as an output)."""
 
     def local(tok, rk, params):
-        out, aux = moe_layer_local(
+        out, aux, drops = moe_layer_local(
             tok, rk, expert_fn,
             jax.tree.map(lambda a: a, params),
-            axis_name=axis_name, capacity_factor=capacity_factor)
-        return out, lax.pmean(aux, axis_name)
+            axis_name=axis_name, capacity_factor=capacity_factor,
+            return_drops=True)
+        return out, lax.pmean(aux, axis_name), lax.psum(drops, axis_name)
 
     fn = shard_map(
         local, mesh=mesh,
         in_specs=(P(axis_name), P(), P(axis_name)),
-        out_specs=(P(axis_name), P()),
+        out_specs=(P(axis_name), P(), P()),
         check_vma=False)
-    return jax.jit(fn)(tokens, router_kernel, stacked_expert_params)
+    out, aux, drops = jax.jit(fn)(tokens, router_kernel,
+                                  stacked_expert_params)
+    record_dropped_tokens(jax.device_get(drops), layer)
+    return out, aux
+
+
+def _softmax_np(x):
+    import numpy as np
+    x = x - x.max(axis=-1, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def moe_layer_hvd(tokens, router_kernel, expert_fn, expert_params, *,
+                  capacity_factor: float = 1.25, layer: str = "0"):
+    """Expert parallelism over the engine's negotiated ``hvd.alltoall``
+    — the 4th collective verb at job scale.
+
+    Where :func:`moe_layer` is the in-jit path (static capacity buffers,
+    ``lax.all_to_all`` inside one compiled program), this is the
+    process-level eager path: routing happens host-side, per-expert
+    counts are exchanged FIRST (a tiny uniform alltoall), so the token
+    exchange itself ships only the kept rows — the alltoallv form with
+    split sizes known on every rank, no padded capacity slots on the
+    wire.  Multi-process correct: the same code runs in the
+    single-controller rig (one process driving n ranks) and under
+    ``hvdrun`` (one rank per process).
+
+    Args: ``tokens`` — list of per-rank [T_k, D] arrays, one entry per
+    rank this process drives; ``router_kernel`` [D, E_total] replicated;
+    ``expert_params`` — list of per-rank pytrees, leaves [E_local, ...]
+    (rank r owns experts ``r*E_local .. (r+1)*E_local-1``).
+
+    Returns ``(outs, aux, dropped)``: per-rank outputs [T_k, D], the
+    mean Switch aux loss over local ranks, and the total overflow drops
+    (also counted into ``hvd_moe_dropped_tokens_total{layer}``).
+    """
+    import numpy as np
+    import horovod_tpu as hvd
+
+    n = hvd.size()
+    toks = [np.asarray(t, np.float32) for t in tokens]
+    rk = np.asarray(router_kernel, np.float32)
+    local = len(toks)
+    E_total = rk.shape[1]
+    if E_total % n:
+        raise ValueError(f"experts ({E_total}) must divide world ({n})")
+    E_local = E_total // n
+
+    counts = np.zeros((local, E_total), np.int32)   # kept per expert
+    send_orders, sends, gates, dropped, auxes = [], [], [], 0, []
+    for k, tok in enumerate(toks):
+        T = tok.shape[0]
+        capacity = max(1, int(T * capacity_factor / E_total))
+        probs = _softmax_np(tok @ rk)
+        eidx = probs.argmax(axis=-1)
+        gate = probs[np.arange(T), eidx]
+        onehot = np.eye(E_total, dtype=np.float32)[eidx]
+        auxes.append(float(
+            E_total * (onehot.mean(0) * probs.mean(0)).sum()))
+        pos = np.empty(T, np.int64)
+        for e in range(E_total):
+            sel = eidx == e
+            pos[sel] = np.arange(int(sel.sum()))
+            counts[k, e] = min(int(sel.sum()), capacity)
+        keep = pos < capacity
+        dropped += int((~keep).sum())
+        kept = np.nonzero(keep)[0]
+        order = kept[np.argsort(eidx[kept], kind="stable")]
+        send_orders.append(order)
+        sends.append(tok[order])
+        gates.append(gate)
+
+    # (1) per-expert counts first — destination j learns exactly how many
+    # rows each source sends for each of its experts, so every split size
+    # below is known before any token moves.
+    splits_cnt = np.full((local, n), E_local, np.int32)
+    cnt_recv = hvd.alltoall([c for c in counts], splits=splits_cnt)
+    # cnt_recv[k]: [n*E_local] — source-major counts for rank k's experts.
+    # (2) kept tokens, expert-ascending per destination block.
+    splits = np.stack([counts[k].reshape(n, E_local).sum(axis=1)
+                       for k in range(local)]).astype(np.int32)
+    data_recv = hvd.alltoall(sends, splits=splits)
+
+    # (3) run the local experts on expert-major regroupings.
+    results = []
+    for k in range(local):
+        cnt = np.asarray(cnt_recv[k]).reshape(n, E_local)
+        block = np.asarray(data_recv[k])          # source-major rows
+        src_off = np.concatenate([[0], cnt.sum(axis=1).cumsum()])
+        within = np.concatenate(
+            [np.zeros((n, 1), np.int64), cnt.cumsum(axis=1)], axis=1)
+        out_rows = np.zeros_like(block)
+        params = expert_params[min(k, len(expert_params) - 1)]
+        for e in range(E_local):
+            rows = [block[src_off[i] + within[i, e]:
+                          src_off[i] + within[i, e + 1]] for i in range(n)]
+            x_e = np.concatenate(rows, axis=0) if cnt[:, e].sum() else None
+            if x_e is None or not len(x_e):
+                continue
+            p_e = jax.tree.map(lambda a: jnp.asarray(a)[e], params)
+            y_e = np.asarray(expert_fn(p_e, jnp.asarray(x_e)))
+            off = 0
+            for i in range(n):
+                m = int(cnt[i, e])
+                out_rows[src_off[i] + within[i, e]:
+                         src_off[i] + within[i, e + 1]] = y_e[off:off + m]
+                off += m
+        results.append(out_rows)
+
+    # (4) inverse exchange: each destination returns exactly the rows it
+    # received, so the transposed split matrix routes them home.
+    splits_back = np.stack([np.asarray(cnt_recv[k]).reshape(
+        n, E_local).sum(axis=1) for k in range(local)]).astype(np.int32)
+    back = hvd.alltoall(results, splits=splits_back)
+
+    outs = []
+    for k, tok in enumerate(toks):
+        out = np.zeros_like(tok)
+        rows = np.asarray(back[k])   # dest-major == my original send order
+        order = send_orders[k]
+        out[order] = gates[k][order, None] * rows
+        outs.append(out)
+    record_dropped_tokens(dropped, layer)
+    return outs, float(np.mean(auxes)) if auxes else 0.0, dropped

@@ -144,6 +144,10 @@ class ElasticDriver:
         self._lock = threading.Lock()
         self._current_hosts: List[HostSlots] = []
         self.membership_epoch = 0
+        #: autoscaler-imposed world size; ``None`` = use full capacity.
+        #: A voluntary shrink sets this below capacity so the next
+        #: assignment retires ranks even though their hosts are healthy.
+        self.target_np: Optional[int] = None
 
     # -- membership ---------------------------------------------------------
     def blacklist(self, hostname: str) -> None:
@@ -262,12 +266,14 @@ class ElasticDriver:
 
     def assignment(self, hosts: Optional[Sequence[HostSlots]] = None
                    ) -> List[tuple[int, str, int]]:
-        """Rank assignment over current (or given) hosts, capped at max_np."""
+        """Rank assignment over current (or given) hosts, capped at
+        max_np and at the autoscaler's ``target_np`` when one is set."""
         if hosts is None:
             with self._lock:
                 hosts = list(self._current_hosts)
         total = sum(h.slots for h in hosts)
-        np_total = min(total, self.max_np) if self.max_np else total
+        np_total = min(total, self.max_np or total, self.target_np or total)
+        np_total = max(np_total, min(self.min_np, total))
         return assign_ranks(list(hosts), np_total)
 
     # -- supervision --------------------------------------------------------
@@ -277,14 +283,24 @@ class ElasticDriver:
                 launcher: Optional[Callable] = None,
                 on_epoch_change: Optional[Callable] = None,
                 slot_timeout_s: float = 600.0,
-                launch_kwargs: Optional[dict] = None) -> int:
+                launch_kwargs: Optional[dict] = None,
+                autoscale=None,
+                autoscale_interval_s: float = 2.0) -> int:
         """Supervise the elastic job: (re)launch on the current assignment
         until it exits 0 or restarts are exhausted.
 
         ``launcher`` defaults to :func:`horovod_tpu.runner.launch.launch_workers`
         (injectable for tests); ``launch_kwargs`` forwards launcher knobs
         (ssh_port, verbose, connectivity_check, ...) to it.
+
+        ``autoscale`` (an :class:`horovod_tpu.autoscale.PolicyConfig`)
+        replaces the plain capacity growth watcher with the full
+        closed-loop controller: each round launches an
+        :class:`~horovod_tpu.autoscale.AutoscaleController` that polls the
+        job's ``/cluster`` signals through the KV store and drives both
+        grow and voluntary shrink via the membership-epoch bump.
         """
+        last_np: dict = {"np": None}
         if launcher is None:
             from .launch import (
                 RESTART_EXIT_CODE,
@@ -294,12 +310,65 @@ class ElasticDriver:
 
             def launcher(cmd, hosts, env):
                 spec = ",".join(f"{h.hostname}:{h.slots}" for h in hosts)
-                np_total = min(sum(h.slots for h in hosts),
-                               self.max_np or 10 ** 9)
+                capacity_now = sum(h.slots for h in hosts)
+                np_total = min(capacity_now, self.max_np or 10 ** 9,
+                               self.target_np or 10 ** 9)
+                np_total = max(np_total, min(self.min_np, capacity_now))
+                env = dict(env)
+                env["HVDTPU_AUTOSCALE_TARGET_NP"] = str(
+                    self.target_np or np_total)
+                prev_np, last_np["np"] = last_np["np"], np_total
                 failure: dict = {}
                 stop_watch = threading.Event()
+                controller_box: list = []
+
+                def autoscale_hook(services):
+                    # Closed loop: sense (/cluster via the job KV) ->
+                    # decide (ScalePolicy) -> act (epoch bump).  One
+                    # controller per launch round; stopped when the
+                    # round's workers exit.
+                    from .._native import KvClient
+                    from ..autoscale import AutoscaleController, ScalePolicy
+                    from ..elastic.runner import WorkerNotificationClient
+                    from ..obs.aggregate import ClusterAggregator
+
+                    def kv_factory():
+                        return KvClient("127.0.0.1", services.kv.port,
+                                        secret=services.secret)
+
+                    agg = ClusterAggregator(include_local=False,
+                                            kv_factory=kv_factory)
+
+                    def capacity() -> int:
+                        try:
+                            self.poll_hosts()
+                        except Exception as e:
+                            log.warning("autoscale: discovery poll "
+                                        "failed: %s", e)
+                        with self._lock:
+                            return sum(h.slots
+                                       for h in self._current_hosts)
+
+                    def bump() -> None:
+                        kv = kv_factory()
+                        try:
+                            WorkerNotificationClient.bump(kv)
+                        finally:
+                            kv.close()
+
+                    def set_target(target: int) -> None:
+                        self.target_np = target
+
+                    controller_box.append(AutoscaleController(
+                        ScalePolicy(autoscale),
+                        current_np=np_total, prev_np=prev_np,
+                        collect=agg.collect, bump=bump,
+                        capacity=capacity, set_target=set_target,
+                        interval_s=autoscale_interval_s).start())
 
                 def services_hook(services):
+                    if autoscale is not None:
+                        return autoscale_hook(services)
                     # Growth watcher: while the job runs, poll discovery;
                     # when total capacity exceeds the running np (and
                     # max_np allows more), bump the membership epoch in
@@ -354,6 +423,8 @@ class ElasticDriver:
                                           **(launch_kwargs or {}))
                 finally:
                     stop_watch.set()
+                    for c in controller_box:
+                        c.stop()
                 if code in (RESTART_EXIT_CODE, VICTIM_EXIT_CODE):
                     # Voluntary membership restart, or a victim of some
                     # other rank's fault: either way, the first-exiting

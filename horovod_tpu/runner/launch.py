@@ -80,6 +80,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "discovery scripts printing bare hostnames; with "
                         "--tpu-pod only for setups partitioning chips "
                         "per-process themselves via TPU_VISIBLE_DEVICES)")
+    p.add_argument("--autoscale", action="store_true", default=False,
+                   help="close the loop between /cluster signals and "
+                        "elastic rendezvous: the driver grows the job on "
+                        "load pressure (queue depth / SLO burn) and "
+                        "shrinks it when idle (elastic mode only; knobs "
+                        "via HVDTPU_AUTOSCALE_*)")
+    p.add_argument("--autoscale-interval", type=float, default=None,
+                   help="seconds between autoscale control ticks "
+                        "(default 2.0)")
     p.add_argument("--elastic-timeout", type=float, default=None,
                    help="seconds to wait for min-np slots before giving up "
                         "(default 600)")
@@ -556,9 +565,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.host_discovery_script:
         return run_elastic(command, args, extra_env)
     if (args.min_np is not None or args.max_np is not None
-            or args.slots is not None or args.elastic_timeout is not None):
-        print("hvdrun: --min-np/--max-np/--slots/--elastic-timeout require "
-              "--host-discovery-script (elastic mode)", file=sys.stderr)
+            or args.slots is not None or args.elastic_timeout is not None
+            or args.autoscale or args.autoscale_interval is not None):
+        print("hvdrun: --min-np/--max-np/--slots/--elastic-timeout/"
+              "--autoscale require --host-discovery-script (elastic "
+              "mode)", file=sys.stderr)
         return 2
     return launch_workers(command, np_total=args.num_proc,
                           hosts_spec=args.hosts, extra_env=extra_env,
@@ -589,8 +600,24 @@ def run_elastic(command: Sequence[str], args, extra_env: dict) -> int:
     discovery = ScriptDiscovery(args.host_discovery_script,
                                 default_slots=args.slots or 1)
     driver = ElasticDriver(discovery, min_np=min_np, max_np=max_np)
+    cfg = config_mod.from_env()
+    autoscale = None
+    if args.autoscale or cfg.autoscale:
+        from ..autoscale import PolicyConfig
+        autoscale = PolicyConfig(
+            min_np=min_np, max_np=max_np,
+            queue_high=cfg.autoscale_queue_high,
+            queue_low=cfg.autoscale_queue_low,
+            burn_threshold=cfg.autoscale_burn_threshold,
+            scale_up_cooldown_s=cfg.autoscale_up_cooldown_s,
+            scale_down_cooldown_s=cfg.autoscale_down_cooldown_s,
+            stale_after_s=cfg.autoscale_stale_s)
     return driver.run_job(
         command, extra_env=extra_env,
+        autoscale=autoscale,
+        autoscale_interval_s=(args.autoscale_interval
+                              if args.autoscale_interval is not None
+                              else cfg.autoscale_interval_s),
         slot_timeout_s=(args.elastic_timeout
                         if args.elastic_timeout is not None else 600.0),
         launch_kwargs={

@@ -1,0 +1,284 @@
+"""Autoscale policy + controller: the decision surface, synchronously.
+
+Every time-dependent behavior (both cooldowns, staleness) runs off the
+injected clock — no sleeps anywhere in this file.  The policy is pure,
+so each rule gets a direct probe: hysteresis band, scale-up and
+scale-down cooldowns, the fast+slow burn AND-gate, the blacklist-aware
+capacity clamp, the straggler shrink veto, and the frozen-signal no-op.
+"""
+
+import pytest
+
+from horovod_tpu.autoscale import (
+    AutoscaleController,
+    PolicyConfig,
+    ScalePolicy,
+    Signals,
+    signals_from_families,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+#: construction stamps both cooldowns (warmup grace); tests that probe
+#: steady-state behavior advance the clock past them first.
+WARM = 1000.0
+
+
+def _policy(**kw):
+    clock = kw.pop("clock", FakeClock())
+    warm = kw.pop("warm", True)
+    cfg = PolicyConfig(**{**dict(min_np=1, max_np=8,
+                                 scale_up_cooldown_s=30.0,
+                                 scale_down_cooldown_s=120.0), **kw})
+    p = ScalePolicy(cfg, clock=clock)
+    if warm:
+        clock.t += WARM
+    return p, clock
+
+
+def _sig(**kw):
+    return Signals(**{**dict(current_np=4, available_slots=8), **kw})
+
+
+# ---------------------------------------------------------------------------
+# hysteresis band
+# ---------------------------------------------------------------------------
+
+def test_hysteresis_band_holds():
+    p, _ = _policy(queue_low=1.0, queue_high=8.0)
+    for q in (1.5, 4.0, 7.9):
+        d = p.decide(_sig(queue_depth=q))
+        assert d.action == "hold", (q, d)
+        assert d.target_np == 4
+
+
+def test_queue_high_grows_to_capacity():
+    p, _ = _policy()
+    d = p.decide(_sig(queue_depth=8.0))
+    assert d.action == "grow" and d.target_np == 8, d
+
+
+def test_queue_low_shrinks_by_divisor():
+    p, _ = _policy(shrink_divisor=2)
+    d = p.decide(_sig(queue_depth=0.5))
+    assert d.action == "shrink" and d.target_np == 2, d
+
+
+def test_shrink_respects_min_np():
+    p, _ = _policy(min_np=3, shrink_divisor=2)
+    d = p.decide(_sig(queue_depth=0.0))
+    assert d.action == "shrink" and d.target_np == 3, d
+    p2, _ = _policy(min_np=4)
+    d2 = p2.decide(_sig(queue_depth=0.0))
+    assert d2.action == "hold", d2
+
+
+# ---------------------------------------------------------------------------
+# cooldowns (both directions, fake clock)
+# ---------------------------------------------------------------------------
+
+def test_scale_up_cooldown_blocks_then_lapses():
+    p, clock = _policy(scale_up_cooldown_s=30.0)
+    assert p.decide(_sig(current_np=2, queue_depth=9.0)).action == "grow"
+    d = p.decide(_sig(current_np=2, queue_depth=9.0))
+    assert d.action == "hold" and "cooldown" in d.reason, d
+    clock.t = WARM + 29.9
+    assert p.decide(_sig(current_np=2, queue_depth=9.0)).action == "hold"
+    clock.t = WARM + 30.1
+    assert p.decide(_sig(current_np=2, queue_depth=9.0)).action == "grow"
+
+
+def test_scale_down_cooldown_blocks_then_lapses():
+    p, clock = _policy(scale_down_cooldown_s=120.0)
+    assert p.decide(_sig(current_np=8, queue_depth=0.0)).action == "shrink"
+    d = p.decide(_sig(current_np=4, queue_depth=0.0))
+    assert d.action == "hold" and "cooldown" in d.reason, d
+    clock.t = WARM + 121.0
+    assert p.decide(_sig(current_np=4, queue_depth=0.0)).action == "shrink"
+
+
+def test_cooldowns_are_independent():
+    # A recent grow must not block a shrink, and vice versa.
+    p, clock = _policy(scale_up_cooldown_s=30.0, scale_down_cooldown_s=30.0)
+    assert p.decide(_sig(current_np=2, queue_depth=9.0)).action == "grow"
+    clock.t = WARM + 1.0
+    assert p.decide(_sig(current_np=8, queue_depth=0.0)).action == "shrink"
+
+
+def test_warmup_grace_blocks_first_shrink():
+    # A freshly constructed policy (job launch) must not shrink a job
+    # that merely looks idle while it warms up — construction stamps
+    # both cooldowns.  Found live: the first controller poll shrank an
+    # hvdrun --autoscale job 2 seconds in, while workers were compiling.
+    p, clock = _policy(warm=False, scale_down_cooldown_s=120.0)
+    d = p.decide(_sig(current_np=4, queue_depth=0.0))
+    assert d.action == "hold" and "cooldown" in d.reason, d
+    clock.t = 121.0
+    assert p.decide(_sig(current_np=4, queue_depth=0.0)).action == "shrink"
+
+
+# ---------------------------------------------------------------------------
+# SLO burn AND-gate
+# ---------------------------------------------------------------------------
+
+def test_burn_requires_both_windows():
+    p, _ = _policy(burn_threshold=1.0)
+    # fast alone: a blip, not pressure.
+    d = p.decide(_sig(current_np=2, burn_fast=50.0, burn_slow=0.2))
+    assert d.action == "hold", d
+    # slow alone: stale history, not pressure.
+    d = p.decide(_sig(current_np=2, burn_fast=0.2, burn_slow=50.0))
+    assert d.action == "hold", d
+    # both: grow.
+    d = p.decide(_sig(current_np=2, burn_fast=1.5, burn_slow=1.5))
+    assert d.action == "grow" and d.target_np == 8, d
+
+
+def test_single_burn_window_also_blocks_shrink():
+    # One window over threshold is not "idle" even with an empty queue.
+    p, _ = _policy()
+    d = p.decide(_sig(current_np=8, queue_depth=0.0, burn_fast=5.0))
+    assert d.action == "hold", d
+
+
+# ---------------------------------------------------------------------------
+# capacity clamp (blacklist-aware) + straggler veto
+# ---------------------------------------------------------------------------
+
+def test_grow_clamped_to_available_slots():
+    # Blacklisted hosts shrink available_slots below max_np.
+    p, _ = _policy(max_np=16)
+    d = p.decide(_sig(current_np=2, available_slots=6, queue_depth=9.0))
+    assert d.action == "grow" and d.target_np == 6, d
+
+
+def test_pressure_at_capacity_holds():
+    p, _ = _policy()
+    d = p.decide(_sig(current_np=8, available_slots=8, queue_depth=9.0))
+    assert d.action == "hold" and "capacity" in d.reason, d
+
+
+def test_max_np_clamps_even_with_slots():
+    p, _ = _policy(max_np=6)
+    d = p.decide(_sig(current_np=2, available_slots=32, queue_depth=9.0))
+    assert d.target_np == 6, d
+
+
+def test_straggler_vetoes_shrink():
+    p, _ = _policy()
+    d = p.decide(_sig(queue_depth=0.0, stragglers=1))
+    assert d.action == "hold" and "straggler" in d.reason, d
+
+
+# ---------------------------------------------------------------------------
+# frozen signals
+# ---------------------------------------------------------------------------
+
+def test_stale_signals_hold_despite_pressure():
+    p, _ = _policy(stale_after_s=10.0)
+    d = p.decide(_sig(current_np=2, queue_depth=50.0, signal_age_s=11.0))
+    assert d.action == "hold" and "stale" in d.reason, d
+
+
+def test_nobody_reporting_is_infinitely_stale():
+    p, _ = _policy()
+    d = p.decide(_sig(queue_depth=0.0, signal_age_s=float("inf")))
+    assert d.action == "hold" and "stale" in d.reason, d
+
+
+# ---------------------------------------------------------------------------
+# signals_from_families: snapshot -> Signals distillation
+# ---------------------------------------------------------------------------
+
+def _fam(name, *samples):
+    return {"name": name,
+            "samples": [{"labels": lb, "value": v} for lb, v in samples]}
+
+
+def test_signals_extracts_and_filters_stale_ranks():
+    fams = [
+        _fam("horovod_tpu_rank_snapshot_age_seconds",
+             ({"rank": "0"}, 1.0), ({"rank": "1"}, 99.0)),
+        _fam("hvd_engine_queue_depth",
+             ({"rank": "0"}, 3.0), ({"rank": "1"}, 50.0)),
+        _fam("horovod_tpu_straggler",
+             ({"rank": "0", "tensor": "t"}, 0.0),
+             ({"rank": "1", "tensor": "t"}, 2.0)),
+        _fam("hvd_slo_burn_rate",
+             ({"rank": "0", "slo": "s", "window": "5m"}, 2.5),
+             ({"rank": "0", "slo": "s", "window": "1h"}, 1.5),
+             ({"rank": "1", "slo": "s", "window": "5m"}, 90.0)),
+    ]
+    s = signals_from_families(fams, current_np=2, available_slots=4,
+                              stale_after_s=10.0)
+    # Rank 1 is stale: its queue (50), straggler, and burn (90) are all
+    # excluded from the vote.
+    assert s.queue_depth == 3.0
+    assert s.stragglers == 0
+    assert s.burn_fast == 2.5 and s.burn_slow == 1.5
+    assert s.signal_age_s == 1.0
+
+
+def test_signals_empty_snapshot_is_stale():
+    s = signals_from_families([], current_np=2, available_slots=4)
+    assert s.signal_age_s == float("inf")
+
+
+# ---------------------------------------------------------------------------
+# controller: record + act (no thread, no sleeps)
+# ---------------------------------------------------------------------------
+
+def _controller(policy, fams, *, current_np, capacity, prev_np=None):
+    bumps, targets = [], []
+    c = AutoscaleController(
+        policy, current_np=current_np, prev_np=prev_np,
+        collect=lambda: fams, bump=lambda: bumps.append(1),
+        capacity=lambda: capacity, set_target=targets.append)
+    return c, bumps, targets
+
+
+def test_controller_grow_bumps_and_sets_target():
+    p, _ = _policy(scale_up_cooldown_s=30.0)
+    fams = [
+        _fam("horovod_tpu_rank_snapshot_age_seconds", ({"rank": "0"}, 0.5)),
+        _fam("hvd_engine_queue_depth", ({"rank": "0"}, 20.0)),
+    ]
+    c, bumps, targets = _controller(p, fams, current_np=2, capacity=4)
+    d = c.poll_once()
+    assert d.action == "grow" and bumps == [1] and targets == [4]
+    # Cooldown makes the next tick a hold: no duplicate bump.
+    assert c.poll_once().action == "hold" and bumps == [1]
+
+
+def test_controller_records_observed_shrink():
+    from horovod_tpu.obs import REGISTRY
+    p, _ = _policy()
+    c, bumps, _ = _controller(p, [], current_np=2, capacity=2, prev_np=4)
+    before = REGISTRY.get(
+        "hvd_autoscale_decisions_total").labels(action="shrink").value
+    c.start()
+    c.stop()
+    after = REGISTRY.get(
+        "hvd_autoscale_decisions_total").labels(action="shrink").value
+    assert after == before + 1
+    assert not bumps  # observed, not initiated: nothing to signal
+    assert c.decisions and c.decisions[0].action == "shrink"
+
+
+def test_controller_survives_collect_failure():
+    p, _ = _policy()
+
+    def boom():
+        raise ConnectionError("kv down")
+
+    c = AutoscaleController(p, current_np=2, collect=boom,
+                            bump=lambda: None, capacity=lambda: 2)
+    d = c.poll_once()
+    assert d.action == "hold" and "stale" in d.reason, d

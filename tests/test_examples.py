@@ -51,6 +51,19 @@ def test_tf_keras_bert_pretrain_example():
 
 
 @pytest.mark.integration
+def test_llama_moe_example():
+    """Expert-parallel MoE Llama (use_moe=True, ep=2) trains real steps
+    under the launcher at np=2 — the acceptance smoke for the MoE
+    workload the autoscale scenario resizes."""
+    res = _hvdrun_example(
+        [os.path.join(REPO, "examples", "llama_moe.py")])
+    assert res.returncode == 0, res.stdout + res.stderr
+    # world size = 2 procs x inherited local device count; ep stays 2.
+    assert "DONE moe rank=0/" in res.stdout, res.stdout
+    assert "ep=2" in res.stdout, res.stdout
+
+
+@pytest.mark.integration
 def test_llama_serve_example():
     """Single-process serving example: continuous batching end to end."""
     env = dict(os.environ)

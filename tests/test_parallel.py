@@ -13,7 +13,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 import horovod_tpu as hvd
 from horovod_tpu.parallel import MeshConfig, build_mesh
 from horovod_tpu.parallel import sharding as shd
-from horovod_tpu.parallel.moe import moe_layer
+from horovod_tpu.parallel.moe import (
+    moe_layer,
+    moe_layer_hvd,
+    moe_layer_local,
+    switch_route,
+)
 from horovod_tpu.parallel.pipeline import pipeline_apply
 from horovod_tpu.parallel.ring_attention import (
     ring_self_attention,
@@ -223,6 +228,121 @@ def test_moe_capacity_drops_overflow():
     out = np.asarray(out)
     zero_rows = (np.abs(out) < 1e-12).all(axis=1).sum()
     assert zero_rows > 0, "expected overflow drops with tiny capacity"
+
+
+def test_switch_route_drop_mask_matches_overflow():
+    # The explicit drop mask must name exactly the tokens past capacity:
+    # dropped[t] <=> token t contributes nothing to dispatch/combine.
+    T, E, C = 16, 4, 2
+    logits = jnp.asarray(np.random.RandomState(3).randn(T, E), jnp.float32)
+    dispatch, combine, _, dropped = switch_route(logits, C)
+    kept_mass = np.asarray(dispatch).sum(axis=(1, 2))   # 1 kept, 0 dropped
+    np.testing.assert_array_equal(np.asarray(dropped), kept_mass == 0.0)
+    # Per-expert kept count never exceeds capacity.
+    per_expert = np.asarray(dispatch).sum(axis=(0, 2))
+    assert (per_expert <= C).all(), per_expert
+    # The combine mass of dropped tokens is exactly zero.
+    assert np.asarray(combine)[np.asarray(dropped)].sum() == 0.0
+
+
+def test_moe_layer_counts_dropped_tokens():
+    from horovod_tpu.obs import REGISTRY
+    T, Dm, E = 64, 4, 8
+    rng = np.random.RandomState(6)
+    tokens = rng.randn(T, Dm).astype(np.float32)
+    router = np.zeros((Dm, E), np.float32)  # uniform → all to expert 0
+    We = np.stack([np.eye(Dm, dtype=np.float32)] * E)
+    mesh = Mesh(np.array(jax.devices()), ("ep",))
+    fam = REGISTRY.get("hvd_moe_dropped_tokens_total")
+    before = fam.labels(layer="t_drop").value
+    moe_layer(
+        jax.device_put(tokens, NamedSharding(mesh, P("ep"))),
+        jax.device_put(router, NamedSharding(mesh, P())),
+        lambda w, x: x @ w,
+        jax.device_put(We, NamedSharding(mesh, P("ep"))),
+        mesh, capacity_factor=0.25, layer="t_drop")
+    delta = fam.labels(layer="t_drop").value - before
+    # All T tokens route to expert 0; its per-shard capacity is 1, so
+    # every shard drops all but one of its tokens.
+    assert delta == T - len(jax.devices()), delta
+
+
+@pytest.mark.parametrize("ep", [1, 2, 4])
+def test_moe_layer_parity_across_ep(ep):
+    """moe_layer over ep ∈ {1,2,4} against the dense per-token oracle.
+
+    Ample capacity (nothing drops — per-shard capacity changes with ep,
+    so drop behavior is only comparable when it never engages).  fp32
+    end to end; einsum dispatch vs direct matmul differ only in
+    summation order, so 1e-5 bounds the drift."""
+    T, Dm, E = 32, 8, 4
+    rng = np.random.RandomState(11)
+    tokens = rng.randn(T, Dm).astype(np.float32)
+    router = rng.randn(Dm, E).astype(np.float32)
+    We = rng.randn(E, Dm, Dm).astype(np.float32) * 0.5
+    mesh = Mesh(np.array(jax.devices()[:ep]), ("ep",))
+    out, aux = moe_layer(
+        jax.device_put(tokens, NamedSharding(mesh, P("ep"))),
+        jax.device_put(router, NamedSharding(mesh, P())),
+        lambda w, x: x @ w,
+        jax.device_put(We, NamedSharding(mesh, P("ep"))),
+        mesh, capacity_factor=float(E))
+    logits = tokens @ router
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    idx = p.argmax(-1)
+    gate = p[np.arange(T), idx]
+    expected = np.stack([gate[t] * (tokens[t] @ We[idx[t]])
+                         for t in range(T)])
+    np.testing.assert_allclose(np.asarray(out), expected,
+                               rtol=1e-5, atol=1e-5)
+    assert float(aux) > 0
+
+
+def test_moe_layer_hvd_parity_with_drops():
+    """The engine-verb path (`hvd.alltoall` dispatch/combine) against a
+    per-rank dense oracle that replicates its capacity-drop rule: kept
+    tokens match the oracle to fp32 tolerance, dropped tokens are
+    exactly zero, and the total feeds the drop counter."""
+    from horovod_tpu.obs import REGISTRY
+    n = hvd.size()
+    D, E, T, cf = 8, 16, 10, 1.25
+    rng = np.random.RandomState(7)
+    router = rng.randn(D, E).astype(np.float32)
+    W = rng.randn(E, D, D).astype(np.float32) * 0.5
+    toks = [rng.randn(T, D).astype(np.float32) for _ in range(n)]
+    E_local = E // n
+    params = [jnp.asarray(W[r * E_local:(r + 1) * E_local])
+              for r in range(n)]
+    fam = REGISTRY.get("hvd_moe_dropped_tokens_total")
+    before = fam.labels(layer="t_hvd").value
+
+    outs, aux, dropped = moe_layer_hvd(
+        toks, router, lambda w, x: x @ w, params,
+        capacity_factor=cf, layer="t_hvd")
+
+    capacity = max(1, int(T * cf / E))
+    oracle_drops = 0
+    for r in range(n):
+        logits = toks[r] @ router
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        idx = p.argmax(-1)
+        gate = p[np.arange(T), idx]
+        seen = {e: 0 for e in range(E)}
+        for t in range(T):
+            e = int(idx[t])
+            if seen[e] < capacity:
+                seen[e] += 1
+                np.testing.assert_allclose(
+                    np.asarray(outs[r][t]), gate[t] * (toks[r][t] @ W[e]),
+                    rtol=1e-5, atol=1e-5)
+            else:
+                oracle_drops += 1
+                np.testing.assert_array_equal(np.asarray(outs[r][t]), 0.0)
+    assert dropped == oracle_drops and oracle_drops > 0
+    assert fam.labels(layer="t_hvd").value - before == oracle_drops
+    assert np.isfinite(aux) and aux > 0
 
 
 def test_pipeline_1f1b_matches_autodiff_oracle():

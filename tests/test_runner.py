@@ -511,3 +511,27 @@ def test_router_failover_scenario_harness():
     assert res.returncode == 0, res.stdout + res.stderr
     assert "CHAOS-ROUTER-OK" in res.stdout, res.stdout
     assert "CHAOS-OK" in res.stdout, res.stdout
+
+
+@pytest.mark.integration
+@pytest.mark.slow
+def test_autoscale_recovery_scenario_harness():
+    """Acceptance (the autoscale-recovery CI job, wrapped): the np=4
+    expert-parallel MoE job under the closed-loop autoscaler — an
+    injected rank death shrinks it to np=2 (blacklist), an SLO burn
+    load spike holds scale-up pressure, and the controller grows it
+    back to np=4 when the cooldown lapses, with exact state continuity
+    and every decision on the metric/flight-recorder record.
+    slow-marked: three full runner rounds plus a real 12s blacklist
+    cooldown (~60-90s wall)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("HVDTPU_FAULTS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.chaos.run",
+         "--scenario", "autoscale"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "CHAOS-AUTOSCALE-OK" in res.stdout, res.stdout
+    assert "CHAOS-OK" in res.stdout, res.stdout
