@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: ci native test mp-test examples bench baseline-table image \
-	autoscale-recovery
+	autoscale-recovery perf-regress bench-trajectory
 
 # The autoscale-recovery CI job standalone: np=4 MoE job, injected rank
 # death + SLO load spike => shrink to np=2, grow back to np=4.
@@ -19,6 +19,7 @@ horovod_tpu.serving"
 	$(PY) -m horovod_tpu.obs.smoke
 	$(PY) benchmarks/baseline_table.py --check
 	$(PY) -m pytest tests -q -x --ignore=tests/test_runner.py
+	$(MAKE) perf-regress
 	$(PY) -m pytest tests/test_runner.py -q -x
 	$(PY) -m horovod_tpu.chaos.run --np 4
 	$(PY) -m horovod_tpu.chaos.run --scenario router
@@ -29,6 +30,18 @@ horovod_tpu.serving"
 # (the jsonl is the source of truth; `--check` in CI fails on drift).
 baseline-table:
 	$(PY) benchmarks/baseline_table.py
+
+# Regenerate BENCH_trajectory.json (normalized perf history) from
+# BENCH_r*.json + measured.jsonl; `regress --check` in CI fails on drift.
+bench-trajectory:
+	$(PY) -m benchmarks.regress --build
+
+# The perf-regress CI job standalone: quick np=8 sweep gated against the
+# committed trajectory (see ci.yaml notes).
+perf-regress:
+	$(PY) -m benchmarks.collective_bench --cpu-devices 8 --quick \
+		> /tmp/perf_sweep.jsonl
+	$(PY) -m benchmarks.regress --check --extra /tmp/perf_sweep.jsonl
 
 # Canonical pinned-environment image (docker/Dockerfile); context must be
 # the repo root so COPY sees the sources.

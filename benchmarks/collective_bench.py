@@ -141,7 +141,28 @@ def allreduce_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
         # One rank has no wire: this is dispatch + HBM throughput, and it
         # must not wear a bus-bandwidth label (round-3 verdict finding).
         row["dispatch_GBs"] = algbw / 1e9
+    _attach_model(row, "allreduce", payload, n, dt, mode=resolved,
+                  chunks=row.get("chunks", 1),
+                  block=cfg.quant_block_size, itemsize=itemsize)
     return row
+
+
+def _attach_model(row: dict, verb: str, payload: int, n: int, dt: float,
+                  *, mode: str = "fp32", chunks: int = 1,
+                  block: int = 512, itemsize: int = 4) -> None:
+    """Feed the fenced wall-clock into the expected-vs-achieved perf
+    model (obs/perfmodel) and carry its attribution on the row, so a
+    sweep's JSON lines double as model-efficiency evidence."""
+    if n <= 1:
+        return
+    from horovod_tpu.obs import perfmodel as PM
+    mrow = PM.MODEL.observe(verb, payload, n, dt, mode=mode,
+                            chunks=chunks, block=block, itemsize=itemsize)
+    if mrow:
+        row["model_efficiency"] = round(mrow["efficiency"], 4)
+        row["model_expected_busbw_GBs"] = round(
+            mrow["expected_busbw_gbs"], 4)
+        row["model_basis"] = mrow["basis"]
 
 
 def alltoall_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
@@ -186,6 +207,7 @@ def alltoall_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
     else:
         # One rank's alltoall is an identity copy — dispatch only.
         row["dispatch_GBs"] = algbw / 1e9
+    _attach_model(row, "alltoall", payload, n, dt, itemsize=itemsize)
     return row
 
 
@@ -222,6 +244,11 @@ def main() -> None:
     ap.add_argument("--out", default=None, metavar="PATH",
                     help="write the schedule-sweep summary as a JSON "
                     "record (BENCH_rXX.json shape)")
+    ap.add_argument("--quick", action="store_true",
+                    help="small sizes only (4KB..1MB) — the CI "
+                    "perf-regress sweep; rows stay comparable with the "
+                    "committed trajectory because the sentinel keys "
+                    "series per size, never on a range-dependent peak")
     ap.add_argument("--verb", default="allreduce",
                     choices=("allreduce", "alltoall"),
                     help="collective to sweep; alltoall is the MoE "
@@ -238,7 +265,9 @@ def main() -> None:
     hvd.global_state().config.quant_min_bytes = 0
     modes = [m.strip() for m in args.wire_precision.split(",") if m.strip()]
     schedules = [s.strip() for s in args.schedule.split(",") if s.strip()]
-    rows = sweep(modes=modes, schedules=schedules, verb=args.verb)
+    sizes = [1 << p for p in range(12, 21, 2)] if args.quick else None
+    rows = sweep(sizes=sizes, modes=modes, schedules=schedules,
+                 verb=args.verb)
     for r in rows:
         print(json.dumps(r))
     key = "busbw_GBs" if "busbw_GBs" in rows[0] else "dispatch_GBs"

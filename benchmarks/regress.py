@@ -1,0 +1,595 @@
+"""Bench regression sentinel: normalize the BENCH history, gate on drops.
+
+The repo accumulates performance evidence in two shapes — per-round
+``BENCH_r*.json`` files (each round's driver record, whose inner schema
+has drifted across rounds) and ``benchmarks/measured.jsonl`` (append-only
+measurement log).  Neither is directly comparable across rounds, so the
+perf trajectory was effectively invisible.  This module makes it one
+table and one gate:
+
+``python -m benchmarks.regress --build``
+    Normalize every BENCH_r*.json + measured.jsonl into
+    ``BENCH_trajectory.json``: one row per (metric, round), each tagged
+    with ``device_kind`` and a ``higher_is_better`` direction.  The file
+    is committed; CI verifies it is fresh.
+
+``python -m benchmarks.regress --check``
+    For every series (metric, device_kind) compare the latest value
+    against the rolling median of the preceding values (window
+    ``--window``, default 5).  A drop worse than ``--max-regress-pct``
+    (default 25% — the CPU rig's shared-core noise makes tighter gates
+    flap; see docs/performance.md) fails the gate unless the series is
+    listed in ``benchmarks/regress_allow.json`` with a reason.
+    **Device kinds never cross-compare**: a ``cpu`` row and a
+    ``TPU v5 lite`` row of the same metric are different series by
+    construction, so losing the TPU and falling back to the CPU rig
+    reads as a new series, not a 10x regression.
+
+``--extra sweep.jsonl``
+    Ingest a fresh ``collective_bench`` sweep (its stdout, one JSON row
+    per line) as a synthetic "live" round and gate it against the
+    committed baselines at ``--extra-max-regress-pct`` (default 60% —
+    live CI rigs are noisier than the curated history).  This is the CI
+    ``perf-regress`` job: quick sweep, then the sentinel decides.
+
+``--inject metric[@device_kind][=value]``
+    Append a synthetic regressed tail to one series and run the check —
+    the self-test that the gate actually fails (used by CI and tests).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+from typing import Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAJECTORY = os.path.join(REPO, "BENCH_trajectory.json")
+ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "regress_allow.json")
+MEASURED = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "measured.jsonl")
+
+#: substrings that mark a metric as lower-is-better (latencies, times).
+_LOWER_BETTER = ("_ms", "_us", "ttft", "itl", "_seconds", "latency")
+
+
+def _higher_is_better(metric: str) -> bool:
+    m = metric.lower()
+    return not any(tok in m for tok in _LOWER_BETTER)
+
+
+def _size_label(nbytes: int) -> str:
+    for unit, shift in (("GB", 30), ("MB", 20), ("KB", 10)):
+        if nbytes >= (1 << shift) and nbytes % (1 << shift) == 0:
+            return f"{nbytes >> shift}{unit}"
+    return f"{nbytes}B"
+
+
+def _row(round_id: str, order: int, metric: str, value, *,
+         unit: str = "", device_kind: str = "unspecified",
+         source: str = "", hib: Optional[bool] = None) -> Optional[dict]:
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return None
+    return {
+        "round": round_id,
+        "order": int(order),
+        "metric": str(metric),
+        "value": v,
+        "unit": str(unit),
+        "device_kind": str(device_kind),
+        "higher_is_better": (_higher_is_better(metric)
+                             if hib is None else bool(hib)),
+        "source": source,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Extractors: one per historical BENCH schema + the shared sweep-row form
+# ---------------------------------------------------------------------------
+
+def extract_bench_row(obj: dict, round_id: str, order: int,
+                      source: str) -> list:
+    """A ``collective_bench`` sweep row (``{op, bytes, ranks, ...}``) —
+    the one shape shared by BENCH_r07 ``rows``, r09 sweeps and live
+    ``--extra`` ingestion, so committed history and fresh sweeps land on
+    identical series names."""
+    out = []
+    op = obj.get("op")
+    nbytes = obj.get("bytes")
+    ranks = obj.get("ranks")
+    if not op or not isinstance(nbytes, (int, float)) or not ranks:
+        return out
+    wp = obj.get("wire_precision") or "fp32"
+    sched = obj.get("schedule") or "monolithic"
+    kind = f"cpu-rig-np{int(ranks)}"
+    size = _size_label(int(nbytes))
+    if "busbw_GBs" in obj:
+        out.append(_row(round_id, order,
+                        f"{op}_{wp}_{sched}_busbw_GBs@{size}",
+                        obj["busbw_GBs"], unit="GB/s", device_kind=kind,
+                        source=source))
+    elif "dispatch_GBs" in obj:
+        out.append(_row(round_id, order,
+                        f"{op}_{wp}_{sched}_dispatch_GBs@{size}",
+                        obj["dispatch_GBs"], unit="GB/s", device_kind=kind,
+                        source=source))
+    return [r for r in out if r]
+
+
+def _extract_parsed(parsed: dict, round_id: str, order: int,
+                    source: str) -> list:
+    """The ``bench.py`` summary record carried as ``.parsed`` in
+    BENCH_r02..r06 (and as whole lines in measured.jsonl)."""
+    out = []
+    kind = parsed.get("device_kind", "unspecified")
+    m = parsed.get("metric")
+    if m and isinstance(parsed.get("value"), (int, float)):
+        out.append(_row(round_id, order, m, parsed["value"],
+                        unit=parsed.get("unit", ""), device_kind=kind,
+                        source=source))
+    if m and isinstance(parsed.get("mfu"), (int, float)):
+        out.append(_row(round_id, order, f"{m}_mfu", parsed["mfu"],
+                        unit="fraction", device_kind=kind, source=source))
+    if m and isinstance(parsed.get("speedup"), (int, float)):
+        out.append(_row(round_id, order, f"{m}_speedup", parsed["speedup"],
+                        unit="x", device_kind=kind, source=source))
+    ar = parsed.get("allreduce_busbw")
+    if isinstance(ar, dict) and isinstance(ar.get("busbw_GBs"),
+                                           (int, float)):
+        out.append(_row(round_id, order, "bench_allreduce_busbw_GBs",
+                        ar["busbw_GBs"], unit="GB/s", device_kind=kind,
+                        source=source))
+    ar = parsed.get("allreduce")
+    if isinstance(ar, dict) and isinstance(ar.get("dispatch_GBs"),
+                                           (int, float)):
+        out.append(_row(round_id, order, "bench_allreduce_dispatch_GBs",
+                        ar["dispatch_GBs"], unit="GB/s", device_kind=kind,
+                        source=source))
+    # Sweep-shaped records (allreduce_busbw_sweep_cpu8, alltoall_...):
+    # per-size points + the peak, device-kind from the platform tag.
+    sweep = parsed.get("sweep")
+    if m and isinstance(sweep, list):
+        skind = parsed.get("platform", kind)
+        for pt in sweep:
+            if isinstance(pt, dict) and isinstance(
+                    pt.get("busbw_GBs"), (int, float)) and "bytes" in pt:
+                out.append(_row(
+                    round_id, order,
+                    f"{m}@{_size_label(int(pt['bytes']))}",
+                    pt["busbw_GBs"], unit="GB/s", device_kind=skind,
+                    source=source))
+        if isinstance(parsed.get("peak_busbw_GBs"), (int, float)):
+            out.append(_row(round_id, order, f"{m}_peak_GBs",
+                            parsed["peak_busbw_GBs"], unit="GB/s",
+                            device_kind=skind, source=source))
+    # flash attention speedups, keyed by sequence length
+    if m == "flash_attention_speedup_tpu":
+        seq = parsed.get("seq_len")
+        for phase in ("fwd", "fwd_bwd"):
+            ph = parsed.get(phase)
+            if seq and isinstance(ph, dict) and isinstance(
+                    ph.get("speedup"), (int, float)):
+                out.append(_row(round_id, order,
+                                f"flash_attention_{phase}_speedup@S{seq}",
+                                ph["speedup"], unit="x",
+                                device_kind=kind, source=source))
+    return [r for r in out if r]
+
+
+def _extract_bench_file(path: str) -> list:
+    name = os.path.basename(path)
+    m = re.match(r"BENCH_r(\d+)\.json$", name)
+    if not m:
+        return []
+    n = int(m.group(1))
+    round_id = f"r{n:02d}"
+    order = n * 1000
+    try:
+        d = json.load(open(path))
+    except (OSError, ValueError):
+        return []
+    rows: list = []
+    if isinstance(d.get("parsed"), dict):
+        rows += _extract_parsed(d["parsed"], round_id, order, name)
+    # r06 wire-precision section
+    wp = d.get("wire_precision")
+    if isinstance(wp, dict):
+        ranks = wp.get("sweep_ranks", 8)
+        kind = f"cpu-rig-np{ranks}"
+        for r in wp.get("fp32_rows", []):
+            if isinstance(r.get("busbw_GBs"), (int, float)):
+                rows.append(_row(
+                    round_id, order,
+                    f"allreduce_fp32_monolithic_busbw_GBs@"
+                    f"{_size_label(int(r['bytes']))}",
+                    r["busbw_GBs"], unit="GB/s", device_kind=kind,
+                    source=name))
+        for r in wp.get("int8_rows", []):
+            if isinstance(r.get("dispatch_GBs"), (int, float)):
+                rows.append(_row(
+                    round_id, order,
+                    f"allreduce_int8_monolithic_dispatch_GBs@"
+                    f"{_size_label(int(r['bytes']))}",
+                    r["dispatch_GBs"], unit="GB/s", device_kind=kind,
+                    source=name))
+        for r in wp.get("at_4MB_plus", []):
+            if isinstance(r.get("wire_reduction"), (int, float)):
+                rows.append(_row(
+                    round_id, order,
+                    f"allreduce_{r.get('mode')}_wire_reduction",
+                    r["wire_reduction"], unit="x", device_kind=kind,
+                    source=name))
+    # r07 schedule sweep + generic rows
+    ss = d.get("schedule_sweep")
+    if isinstance(ss, dict):
+        ranks = ss.get("sweep_ranks", 8)
+        kind = f"cpu-rig-np{ranks}"
+        for ent in ss.get("fp32", []):
+            sched = ent.get("schedule")
+            for sbytes, ratio in (ent.get(
+                    "measured_dispatch_ratio_by_size") or {}).items():
+                if isinstance(ratio, (int, float)):
+                    rows.append(_row(
+                        round_id, order,
+                        f"allreduce_fp32_{sched}_dispatch_ratio@"
+                        f"{_size_label(int(sbytes))}",
+                        ratio, unit="x", device_kind=kind, source=name))
+        comp = ss.get("int8_composition_at_4MB")
+        if isinstance(comp, dict):
+            if isinstance(comp.get("monolithic_dispatch_GBs"),
+                          (int, float)):
+                rows.append(_row(
+                    round_id, order,
+                    "allreduce_int8_monolithic_dispatch_GBs@4MB",
+                    comp["monolithic_dispatch_GBs"], unit="GB/s",
+                    device_kind=kind, source=name))
+            if isinstance(comp.get("rs_ag4_dispatch_GBs"), (int, float)):
+                rows.append(_row(
+                    round_id, order,
+                    "allreduce_int8_rs_ag:4_dispatch_GBs@4MB",
+                    comp["rs_ag4_dispatch_GBs"], unit="GB/s",
+                    device_kind=kind, source=name))
+    for r in d.get("rows", []) if isinstance(d.get("rows"), list) else []:
+        rows += extract_bench_row(r, round_id, order, name)
+    # r08 front door
+    fd = d.get("frontdoor")
+    if isinstance(fd, dict):
+        pc = fd.get("prefix_cache", {})
+        if isinstance(pc.get("hit_rate"), (int, float)):
+            rows.append(_row(round_id, order, "frontdoor_prefix_hit_rate",
+                             pc["hit_rate"], unit="fraction",
+                             device_kind="cpu", source=name))
+        tt = fd.get("ttft", {})
+        if isinstance(tt.get("warm_delta_pct"), (int, float)):
+            rows.append(_row(round_id, order,
+                             "frontdoor_warm_ttft_delta_pct",
+                             tt["warm_delta_pct"], unit="%",
+                             device_kind="cpu", source=name, hib=True))
+        sd = fd.get("spec_decode", {})
+        sd = sd.get("self_draft", {}) if isinstance(sd, dict) else {}
+        if isinstance(sd.get("accept_rate"), (int, float)):
+            rows.append(_row(round_id, order,
+                             "spec_decode_self_draft_accept_rate",
+                             sd["accept_rate"], unit="fraction",
+                             device_kind="cpu", source=name))
+    # r09 alltoall sweeps + peaks
+    a2a = d.get("alltoall")
+    if isinstance(a2a, dict):
+        for key, val in a2a.items():
+            m2 = re.match(r"sweep_np(\d+)$", key)
+            if m2 and isinstance(val, list):
+                np_ = int(m2.group(1))
+                for pt in val:
+                    if isinstance(pt.get("busbw_GBs"), (int, float)):
+                        rows.append(_row(
+                            round_id, order,
+                            f"alltoall_fp32_monolithic_busbw_GBs@"
+                            f"{_size_label(int(pt['bytes']))}",
+                            pt["busbw_GBs"], unit="GB/s",
+                            device_kind=f"cpu-rig-np{np_}", source=name))
+        peaks = a2a.get("peaks")
+        if isinstance(peaks, dict):
+            for npname, pk in peaks.items():
+                if isinstance(pk, dict) and isinstance(
+                        pk.get("busbw_GBs"), (int, float)):
+                    rows.append(_row(
+                        round_id, order, "alltoall_busbw_peak_GBs",
+                        pk["busbw_GBs"], unit="GB/s",
+                        device_kind=f"cpu-rig-{npname}", source=name))
+    return [r for r in rows if r]
+
+
+def _extract_measured(path: str) -> list:
+    rows: list = []
+    if not os.path.exists(path):
+        return rows
+    with open(path) as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            # measured.jsonl is append-only, so line order IS time order;
+            # place all of it after the BENCH rounds it interleaves with
+            # (duplicated points — bench.py's summary is also a measured
+            # line — merely repeat a value inside the rolling window).
+            rows += _extract_parsed(obj, "measured", 100000 + i,
+                                    "measured.jsonl")
+    return rows
+
+
+def build_trajectory(repo: str = REPO,
+                     measured: str = MEASURED) -> dict:
+    rows: list = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        rows += _extract_bench_file(path)
+    rows += _extract_measured(measured)
+    rows.sort(key=lambda r: (r["metric"], r["device_kind"], r["order"]))
+    rounds = sorted({r["round"] for r in rows})
+    return {
+        "generated_by": "python -m benchmarks.regress --build",
+        "rounds": rounds,
+        "series": len({(r["metric"], r["device_kind"]) for r in rows}),
+        "rows": rows,
+    }
+
+
+# ---------------------------------------------------------------------------
+# The gate
+# ---------------------------------------------------------------------------
+
+def load_allowlist(path: str = ALLOWLIST) -> list:
+    try:
+        d = json.load(open(path))
+        return d.get("allow", [])
+    except (OSError, ValueError):
+        return []
+
+
+def _allowed(metric: str, kind: str, allowlist: list) -> Optional[str]:
+    for a in allowlist:
+        if a.get("metric") == metric and \
+                a.get("device_kind", "*") in ("*", kind):
+            return a.get("reason", "allowlisted")
+    return None
+
+
+def check_series(rows: list, *, max_regress_pct: float = 25.0,
+                 window: int = 5, allowlist: Optional[list] = None,
+                 only_rounds: Optional[set] = None) -> list:
+    """Evaluate every (metric, device_kind) series; returns result
+    records with ``status`` in {ok, single, improved, regressed,
+    allowed}.  ``only_rounds`` restricts *judgement* to series whose
+    latest row belongs to one of those rounds (used for --extra: gate
+    only what the live sweep touched)."""
+    allowlist = allowlist or []
+    series: dict = {}
+    for r in rows:
+        series.setdefault((r["metric"], r["device_kind"]), []).append(r)
+    results = []
+    for (metric, kind), srows in sorted(series.items()):
+        srows = sorted(srows, key=lambda r: r["order"])
+        vals = [r["value"] for r in srows]
+        last = srows[-1]
+        if only_rounds is not None and last["round"] not in only_rounds:
+            continue
+        rec = {"metric": metric, "device_kind": kind,
+               "n": len(vals), "latest": last["value"],
+               "round": last["round"],
+               "higher_is_better": last["higher_is_better"]}
+        if len(vals) < 2:
+            rec.update(status="single", baseline=None, delta_pct=None)
+            results.append(rec)
+            continue
+        prior = vals[:-1][-window:]
+        baseline = statistics.median(prior)
+        if baseline == 0:
+            rec.update(status="ok", baseline=0.0, delta_pct=None)
+            results.append(rec)
+            continue
+        delta_pct = (last["value"] - baseline) / abs(baseline) * 100.0
+        rec.update(baseline=baseline, delta_pct=round(delta_pct, 1))
+        worse = (delta_pct < -max_regress_pct if last["higher_is_better"]
+                 else delta_pct > max_regress_pct)
+        better = (delta_pct > max_regress_pct
+                  if last["higher_is_better"]
+                  else delta_pct < -max_regress_pct)
+        if worse:
+            reason = _allowed(metric, kind, allowlist)
+            if reason:
+                rec.update(status="allowed", reason=reason)
+            else:
+                rec.update(status="regressed")
+        elif better:
+            rec.update(status="improved")
+        else:
+            rec.update(status="ok")
+        results.append(rec)
+    return results
+
+
+def ingest_extra(path: str) -> list:
+    """A live collective_bench sweep (stdout JSON lines) as round
+    ``live`` — only rows in the shared sweep-row shape are gated."""
+    rows: list = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line or not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            rows += extract_bench_row(obj, "live", 10 ** 9,
+                                      os.path.basename(path))
+    return rows
+
+
+def _inject(rows: list, spec: str, max_regress_pct: float) -> list:
+    """``metric[@device_kind][=value]`` -> appended synthetic tail that
+    regresses the series (2x the threshold when no value given)."""
+    val = None
+    if "=" in spec:
+        spec, _, v = spec.partition("=")
+        val = float(v)
+    # Metric names may themselves contain '@' (per-size sweep series), so
+    # an exact name wins; otherwise the LAST '@' separates the device kind.
+    metric, kind = spec, ""
+    if "@" in spec and not any(r["metric"] == spec for r in rows):
+        metric, _, kind = spec.rpartition("@")
+    cands = [r for r in rows if r["metric"] == metric
+             and (not kind or r["device_kind"] == kind)]
+    if not cands:
+        raise SystemExit(f"--inject: no series named {metric!r}"
+                         + (f" on {kind!r}" if kind else ""))
+    last = max(cands, key=lambda r: r["order"])
+    if val is None:
+        factor = 2.0 * max_regress_pct / 100.0
+        val = (last["value"] * (1.0 - factor)
+               if last["higher_is_better"]
+               else last["value"] * (1.0 + factor))
+    synth = dict(last)
+    synth.update(round="injected", order=2 * 10 ** 9, value=val,
+                 source="--inject")
+    return rows + [synth]
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _print_report(results: list, label: str, verbose: bool) -> tuple:
+    order = {"regressed": 0, "allowed": 1, "improved": 2, "ok": 3,
+             "single": 4}
+    results = sorted(results, key=lambda r: (order.get(r["status"], 9),
+                                             r["metric"]))
+    counts: dict = {}
+    for r in results:
+        counts[r["status"]] = counts.get(r["status"], 0) + 1
+        if r["status"] in ("regressed", "allowed", "improved") or verbose:
+            delta = ("" if r.get("delta_pct") is None
+                     else f" {r['delta_pct']:+.1f}% vs median "
+                          f"{r['baseline']:g}")
+            extra = (f"  [{r.get('reason')}]"
+                     if r["status"] == "allowed" else "")
+            print(f"[{label}] {r['status'].upper():9} "
+                  f"{r['metric']} ({r['device_kind']}) "
+                  f"latest={r['latest']:g}{delta}{extra}")
+    summary = ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+    print(f"[{label}] {len(results)} series: {summary or 'none'}")
+    bad = [r for r in results if r["status"] == "regressed"]
+    return bad, results
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m benchmarks.regress",
+        description="normalize BENCH history / gate on perf regressions")
+    ap.add_argument("--build", action="store_true",
+                    help="rebuild BENCH_trajectory.json from "
+                    "BENCH_r*.json + measured.jsonl")
+    ap.add_argument("--check", action="store_true",
+                    help="gate: fail on >N%% regression in any series' "
+                    "latest value vs its rolling median baseline")
+    ap.add_argument("--trajectory", default=TRAJECTORY, metavar="PATH",
+                    help="trajectory file to build/check "
+                    "(default: committed BENCH_trajectory.json)")
+    ap.add_argument("--max-regress-pct", type=float, default=25.0,
+                    metavar="N", help="committed-history regression "
+                    "threshold in percent (default 25)")
+    ap.add_argument("--window", type=int, default=5, metavar="W",
+                    help="rolling-median window (default 5)")
+    ap.add_argument("--extra", action="append", default=[],
+                    metavar="FILE", help="live collective_bench sweep "
+                    "output (JSON lines) to gate against the committed "
+                    "baselines as round 'live'")
+    ap.add_argument("--extra-max-regress-pct", type=float, default=60.0,
+                    metavar="N", help="threshold for --extra rows "
+                    "(default 60; live CI rigs are noisy)")
+    ap.add_argument("--inject", default=None, metavar="SPEC",
+                    help="metric[@device_kind][=value]: append a "
+                    "synthetic regressed tail (self-test that the gate "
+                    "fails)")
+    ap.add_argument("--no-freshness", action="store_true",
+                    help="skip the committed-trajectory freshness check")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print every series, not just notable ones")
+    args = ap.parse_args(argv)
+    if not args.build and not args.check:
+        ap.error("pick at least one of --build / --check")
+
+    if args.build:
+        traj = build_trajectory()
+        with open(args.trajectory, "w") as fh:
+            json.dump(traj, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"[build] wrote {args.trajectory}: {len(traj['rows'])} rows,"
+              f" {traj['series']} series, rounds={traj['rounds']}")
+
+    if not args.check:
+        return 0
+
+    try:
+        traj = json.load(open(args.trajectory))
+    except (OSError, ValueError) as e:
+        print(f"[check] cannot read {args.trajectory}: {e}; run "
+              "python -m benchmarks.regress --build", file=sys.stderr)
+        return 2
+    rows = traj.get("rows", [])
+
+    rc = 0
+    # Freshness: the committed trajectory must match a rebuild, the same
+    # contract baseline_table.py --check enforces for BASELINE.md.
+    if args.trajectory == TRAJECTORY and not args.no_freshness \
+            and not args.build:
+        fresh = build_trajectory()["rows"]
+        if fresh != rows:
+            print("[check] BENCH_trajectory.json is STALE vs "
+                  "BENCH_r*.json + measured.jsonl: run "
+                  "python -m benchmarks.regress --build and commit",
+                  file=sys.stderr)
+            rc = 1
+
+    allowlist = load_allowlist()
+    if args.inject:
+        rows = _inject(rows, args.inject, args.max_regress_pct)
+
+    bad, _ = _print_report(
+        check_series(rows, max_regress_pct=args.max_regress_pct,
+                     window=args.window, allowlist=allowlist),
+        "history", args.verbose)
+    if bad:
+        rc = 1
+
+    for path in args.extra:
+        live = ingest_extra(path)
+        if not live:
+            print(f"[live] {path}: no sweep rows found", file=sys.stderr)
+            continue
+        bad, _ = _print_report(
+            check_series(rows + live,
+                         max_regress_pct=args.extra_max_regress_pct,
+                         window=args.window, allowlist=allowlist,
+                         only_rounds={"live"}),
+            "live", args.verbose)
+        if bad:
+            rc = 1
+
+    print("[check]", "FAIL" if rc else "PASS")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -450,14 +450,21 @@ def main() -> None:
                 hvd.cluster_metrics()
             agg_stop.wait(obs.aggregate.publish_interval_from_env())
 
+    from horovod_tpu.obs import prof as obs_prof
     from horovod_tpu.obs import trace as obs_trace
     saved_rate = obs_trace.TRACER.sample_rate
+    # The sampler is on by default after init; park it so the baseline
+    # conditions don't silently include its cost, then measure it as its
+    # own condition below.
+    prof_was_running = obs_prof.PROFILER.running
+    obs_prof.PROFILER.stop()
     agg_thread = _threading.Thread(target=_aggregate_loop, daemon=True)
     agg_thread.start()
     # Interleaved repetitions, median rate per condition: one closed
     # pass is sub-second on this rig and single-pass deltas swing far
     # beyond the 2% being measured (scheduler noise, not obs cost).
-    rates: dict[str, list[float]] = {"on": [], "trace": [], "off": []}
+    rates: dict[str, list[float]] = {"on": [], "trace": [], "prof": [],
+                                     "off": []}
     try:
         for _ in range(3):
             # metrics + aggregation, tracing off — the registry cost
@@ -472,6 +479,14 @@ def main() -> None:
             tok, wall, _ = run_engine(sess, reqs, 0.0)
             rates["trace"].append(tok / wall)
             obs_trace.TRACER.sample_rate = 0.0
+            # + the sampling profiler at its default 10 Hz (obs/prof):
+            # every tick stack-walks all threads; the acceptance budget
+            # says that stays under 2% too.
+            obs_prof.PROFILER.configure(hz=10.0)
+            obs_prof.PROFILER.start()
+            tok, wall, _ = run_engine(sess, reqs, 0.0)
+            rates["prof"].append(tok / wall)
+            obs_prof.PROFILER.stop()
             agg_pause.set()
             obs.REGISTRY.disable()
             try:
@@ -484,16 +499,24 @@ def main() -> None:
         agg_stop.set()
         agg_thread.join(timeout=5)
         obs_trace.TRACER.sample_rate = saved_rate
-    rate_on, rate_tr, rate_off = (float(np.median(rates[k]))
-                                  for k in ("on", "trace", "off"))
+        if prof_was_running:
+            obs_prof.PROFILER.start()
+    rate_on, rate_tr, rate_pr, rate_off = (float(np.median(rates[k]))
+                                           for k in ("on", "trace",
+                                                     "prof", "off"))
     overhead_pct = (rate_off - rate_on) / rate_off * 100.0
     trace_overhead_pct = (rate_off - rate_tr) / rate_off * 100.0
+    prof_overhead_pct = (rate_off - rate_pr) / rate_off * 100.0
     print(f"[obs overhead] metrics+aggregation on {rate_on:.1f} tok/s vs "
           f"off {rate_off:.1f} tok/s = {overhead_pct:+.2f}% "
           f"({'within' if overhead_pct < 2.0 else 'OVER'} the 2% budget)")
     print(f"[obs overhead] +tracing@1.0 {rate_tr:.1f} tok/s vs "
           f"off {rate_off:.1f} tok/s = {trace_overhead_pct:+.2f}% "
           f"({'within' if trace_overhead_pct < 2.0 else 'OVER'} "
+          f"the 2% budget)")
+    print(f"[obs overhead] +profiler@10Hz {rate_pr:.1f} tok/s vs "
+          f"off {rate_off:.1f} tok/s = {prof_overhead_pct:+.2f}% "
+          f"({'within' if prof_overhead_pct < 2.0 else 'OVER'} "
           f"the 2% budget)")
 
     base_rate = base_tok / base_s
@@ -523,6 +546,7 @@ def main() -> None:
             "max_active": max_active,
             "metrics_overhead_pct": round(overhead_pct, 3),
             "tracing_overhead_pct": round(trace_overhead_pct, 3),
+            "prof_overhead_pct": round(prof_overhead_pct, 3),
             "slo": args.slo,
             "d_model": cfg.d_model,
             "n_layers": cfg.n_layers,

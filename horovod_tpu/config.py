@@ -133,6 +133,28 @@ class Config:
     # Seconds between SLO histogram snapshots / evaluations.
     slo_tick_s: float = 10.0
 
+    # --- sampling profiler (obs/prof.py) ---
+    # Stack-sampling rate in Hz for the always-on profiler; 0 disables.
+    # 10 Hz costs ~100 us/tick for a dozen threads — well inside the <2%
+    # overhead budget the serving benchmark asserts.
+    prof_hz: float = 10.0
+    # Bound on distinct (thread, stack) rows in the hot-stack table;
+    # further new stacks are counted as evicted, existing rows still
+    # accumulate.
+    prof_max_stacks: int = 512
+    # Ticks kept in the recent-sample ring (the per-thread "where is
+    # everyone right now" view that flight-recorder bundles embed).
+    prof_ring: int = 64
+
+    # --- performance model (obs/perfmodel.py) ---
+    # Per-device interconnect bandwidth in GB/s for the expected-cost
+    # link model; 0 = self-calibrate against the rolling observed peak
+    # per (verb, tier) — the right default on the CPU bench rig, where
+    # nominal link GB/s is meaningless.
+    perf_link_gbs: float = 0.0
+    # Per-hop latency in microseconds for the link model's step term.
+    perf_link_latency_us: float = 1.0
+
     # --- flight recorder (obs/flightrec.py) ---
     # Directory for auto-dumped postmortem bundles (stall shutdown,
     # round abort, elastic failure, crash).  None = manual
@@ -243,6 +265,11 @@ _ENV_TABLE = [
     ("trace_sample", "TRACE_SAMPLE", float),
     ("slo", "SLO", str),
     ("slo_tick_s", "SLO_TICK_SECONDS", float),
+    ("prof_hz", "PROF_HZ", float),
+    ("prof_max_stacks", "PROF_MAX_STACKS", int),
+    ("prof_ring", "PROF_RING", int),
+    ("perf_link_gbs", "PERF_LINK_GBS", float),
+    ("perf_link_latency_us", "PERF_LINK_LATENCY_US", float),
     ("flight_recorder_dir", "FLIGHT_RECORDER_DIR", str),
     ("flight_recorder_size", "FLIGHT_RECORDER_SIZE", int),
     ("stall_check", "STALL_CHECK_DISABLE", lambda v: not _parse_bool(v)),

@@ -270,6 +270,8 @@ def _arm_obs_plane() -> None:
     from .obs import REGISTRY as obs_registry
     from .obs import aggregate as obs_aggregate
     from .obs import flightrec as obs_flightrec
+    from .obs import perfmodel as obs_perfmodel
+    from .obs import prof as obs_prof
     from .obs import server as obs_server
     from .obs import slo as obs_slo
     from .obs import trace as obs_trace
@@ -316,6 +318,16 @@ def _arm_obs_plane() -> None:
     obs_flightrec.RECORDER.set_capacity(cfg.flight_recorder_size)
     if cfg.flight_recorder_dir:
         obs_flightrec.RECORDER.arm(cfg.flight_recorder_dir)
+
+    # Sampling profiler: always-on at the configured hz (0 disables);
+    # re-entrant — elastic re-init retunes a live sampler in place.
+    obs_prof.arm_from_config(cfg)
+
+    # Performance model: the expected-cost denominator.  Configured link
+    # model when the operator declared one; rolling-peak calibration
+    # otherwise (the CPU rig default).
+    obs_perfmodel.MODEL.configure(link_gbs=cfg.perf_link_gbs,
+                                  link_latency_us=cfg.perf_link_latency_us)
 
     # SLO engine: declarative objectives evaluated against the registry;
     # gauges ride the snapshot path to /cluster with no extra wiring.
@@ -402,10 +414,14 @@ def shutdown() -> None:
         if not _state.initialized:
             return
         from .obs import aggregate as obs_aggregate
+        from .obs import prof as obs_prof
         from .obs import server as obs_server
         from .obs import slo as obs_slo
         obs_aggregate.stop()
         obs_slo.disarm()
+        # Symmetric with the arm in init(): the sampler belongs to the
+        # library lifecycle, not the process.
+        obs_prof.PROFILER.stop()
         # /healthz answers 503 from here until the next init() — the
         # elastic re-rendezvous window a router probe must see as down.
         obs_server.set_health_provider(None)

@@ -15,7 +15,8 @@ counters/gauges/histograms into one process-wide
 Stdlib-only by design; importing this package never imports jax.
 """
 
-from . import export, flightrec, server, slo, trace  # noqa: F401
+from . import (  # noqa: F401
+    export, flightrec, perfmodel, prof, server, slo, trace)
 from .registry import (  # noqa: F401
     Counter,
     DEFAULT_TIME_BUCKETS,

@@ -201,6 +201,7 @@ class FlightRecorder:
                 "events": self.snapshot(),
                 "stall": format_stall(stall) if stall else {},
                 "metrics": _jsonsafe(REGISTRY.snapshot()),
+                "profile": self._profile_summary(),
             }
             if extra:
                 bundle["extra"] = _jsonsafe(dict(extra))
@@ -224,6 +225,18 @@ class FlightRecorder:
             except Exception:
                 pass
             return None
+
+    @staticmethod
+    def _profile_summary() -> dict:
+        """The sampling profiler's recent per-thread stack ring — a
+        stall bundle then shows *where* each rank was stuck, not just
+        which ranks went missing.  Guarded like everything else here:
+        a broken profiler must not cost us the bundle."""
+        try:
+            from .prof import PROFILER
+            return PROFILER.flight_summary()
+        except Exception:
+            return {}
 
     def maybe_dump(self, reason: str, *, stall: Optional[dict] = None,
                    extra: Optional[dict] = None) -> Optional[str]:

@@ -1,0 +1,387 @@
+"""Expected-vs-achieved collective performance model.
+
+GC3's core observation (PAPERS.md) is that once a collective is a
+*schedule* — explicit chunks, wire precision, tiers — its cost is
+predictable.  Our :mod:`horovod_tpu.ops.sched` IR carries exactly those
+parameters, so this module walks them analytically: for a verb at a
+payload size on ``n`` ranks with a wire mode and a schedule descriptor it
+computes expected **wire bytes per device** (ring accounting, mirroring
+:func:`horovod_tpu.ops.reduction.ring_wire_bytes` — duplicated here in
+pure stdlib form because the obs plane must stay importable without
+jax; tests assert the two agree), expected **latency steps**, and the
+**algorithmic busbw factor** that converts measured seconds into the
+NCCL-tests bus bandwidth the benchmarks already report.
+
+Achieved timings come from the instrumented call sites:
+
+- :meth:`PerfModel.observe` — monolithic engine dispatches
+  (ops/engine.py times each fused-group dispatch) and fenced benchmark
+  loops (benchmarks/collective_bench.py);
+- :meth:`PerfModel.observe_schedule` — the sched executor's existing
+  per-step dispatch windows (comm/compute span lists it already keeps
+  for ``hvd_sched_overlap_fraction``);
+- :meth:`PerfModel.observe_tiers` — the two-tier hierarchical path,
+  attributing excess time per tier (ROADMAP item 3's straggler signal).
+
+Efficiency needs a denominator.  Two sources, in priority order:
+
+1. **Configured link model** (``HVDTPU_PERF_LINK_GBS`` +
+   ``HVDTPU_PERF_LINK_LATENCY_US``): expected seconds =
+   steps * latency + wire_bytes / (gbs * 1e9); efficiency =
+   expected / achieved.  This is the honest mode on hardware whose
+   interconnect you know (TPU ICI).
+2. **Rolling observed peak** (default): per ``(verb, tier)`` series the
+   model remembers the best achieved busbw and reports efficiency
+   relative to it.  Self-calibrating on any rig — exactly what the CPU
+   bench rig needs, where "the link" is shared memory and nominal GB/s
+   is meaningless — and still surfaces regressions (efficiency sinking
+   vs the peak the same process already demonstrated).
+
+All gauges carry ``{verb, mode, schedule, tier}`` so /cluster merges
+them per rank and a straggler shows up as one rank's efficiency sitting
+under its peers'.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+from .registry import REGISTRY
+
+#: ring-accounting per-element wire widths, mirroring
+#: ops/reduction.ring_wire_bytes (asserted equal in tests/test_perfmodel)
+_CAST_MODES = ("bf16", "fp16")
+_QUANT_MODES = ("int8", "fp8")
+
+_m_eff = REGISTRY.gauge(
+    "hvd_perf_efficiency",
+    "achieved / expected collective performance (1.0 = model bound)",
+    ("verb", "mode", "schedule", "tier"))
+_m_achieved = REGISTRY.gauge(
+    "hvd_perf_achieved_busbw_gbs",
+    "latest achieved algorithmic bus bandwidth, GB/s",
+    ("verb", "mode", "schedule", "tier"))
+_m_expected = REGISTRY.gauge(
+    "hvd_perf_expected_busbw_gbs",
+    "model-expected bus bandwidth, GB/s (link model or rolling peak)",
+    ("verb", "mode", "schedule", "tier"))
+_m_obs = REGISTRY.counter(
+    "hvd_perf_observations_total",
+    "collective timings fed into the performance model", ("verb",))
+_m_imbalance = REGISTRY.gauge(
+    "hvd_perf_chunk_imbalance",
+    "slowest/mean per-chunk comm window of the latest decomposed "
+    "schedule (1.0 = perfectly balanced)")
+_m_tier_excess = REGISTRY.gauge(
+    "hvd_perf_tier_excess_seconds",
+    "achieved-minus-expected time attributed to one hierarchy tier "
+    "(positive = this tier is the straggler)", ("tier",))
+_m_tier_frac = REGISTRY.gauge(
+    "hvd_perf_tier_expected_fraction",
+    "fraction of total expected collective time the model assigns to "
+    "one hierarchy tier", ("tier",))
+
+
+def wire_per_elem(mode: str, itemsize: int = 4, block: int = 512) -> float:
+    """Ring-accounting wire bytes per logical element, both halves
+    (reduce-scatter + allgather), before the (n-1)/n fraction."""
+    if mode in _CAST_MODES:
+        return 4.0
+    if mode in _QUANT_MODES:
+        return 3.0 + 8.0 / block
+    return 2.0 * itemsize
+
+
+def busbw_factor(verb: str, n: int) -> float:
+    """NCCL-tests algbw -> busbw factor: what fraction of the payload
+    each device's links actually move."""
+    if n <= 1:
+        return 0.0
+    if verb in ("allreduce", "grouped_allreduce", "adasum_allreduce"):
+        return 2.0 * (n - 1) / n
+    # allgather / reducescatter / alltoall / broadcast rings all move
+    # (n-1)/n of the full payload per device.
+    return (n - 1) / n
+
+
+@dataclasses.dataclass(frozen=True)
+class TierCost:
+    """Per-tier slice of an expected cost (hierarchical schedules)."""
+    wire_bytes: float       # bytes per device moved on this tier
+    steps: int              # serial latency steps on this tier
+
+
+@dataclasses.dataclass(frozen=True)
+class ExpectedCost:
+    """Analytic cost of one collective on ``n`` ranks.
+
+    ``wire_bytes`` is per device (ring accounting); ``steps`` is the
+    serial latency-step count of the critical path; ``busbw_factor``
+    converts ``payload_bytes / seconds`` (algbw) into busbw.
+    """
+    verb: str
+    mode: str
+    schedule: str
+    n: int
+    payload_bytes: int
+    wire_bytes: float
+    steps: int
+    busbw_factor: float
+    tiers: dict = dataclasses.field(default_factory=dict)
+
+    def expected_seconds(self, gbs: float, latency_us: float) -> float:
+        """Link-model time: serial step latency + wire transfer."""
+        if gbs <= 0:
+            raise ValueError("link GB/s must be positive")
+        return (self.steps * latency_us * 1e-6
+                + self.wire_bytes / (gbs * 1e9))
+
+
+def expected_allreduce(payload_bytes: int, n: int, *, mode: str = "fp32",
+                       chunks: int = 1, block: int = 512,
+                       itemsize: int = 4) -> ExpectedCost:
+    """Monolithic (chunks=1) or rs_ag-decomposed (chunks=k) allreduce.
+
+    Chunking does not change total wire bytes — every chunk still rides
+    a full reduce-scatter + allgather ring — but it multiplies latency
+    steps (each chunk pays its own 2*(n-1) hops) while buying the
+    executor room to overlap chunk c+1's comm under chunk c's compute.
+    """
+    if n < 1 or payload_bytes < 0:
+        raise ValueError(f"bad inputs n={n} bytes={payload_bytes}")
+    mode = mode or "fp32"
+    numel = payload_bytes / max(1, itemsize)
+    frac = (n - 1) / n if n > 1 else 0.0
+    wire = frac * wire_per_elem(mode, itemsize, block) * numel
+    k = max(1, int(chunks))
+    steps = 2 * (n - 1) * k if n > 1 else 0
+    sched = "monolithic" if k == 1 else f"rs_ag:{k}"
+    return ExpectedCost(verb="allreduce", mode=mode, schedule=sched,
+                        n=n, payload_bytes=payload_bytes, wire_bytes=wire,
+                        steps=steps, busbw_factor=busbw_factor(
+                            "allreduce", n))
+
+
+def expected_collective(verb: str, payload_bytes: int, n: int, *,
+                        itemsize: int = 4) -> ExpectedCost:
+    """Single-phase verbs: allgather / reducescatter / alltoall /
+    broadcast.  ``payload_bytes`` is the full (gathered / scattered)
+    logical payload; each device moves its (n-1)/n share once."""
+    if n < 1 or payload_bytes < 0:
+        raise ValueError(f"bad inputs n={n} bytes={payload_bytes}")
+    frac = (n - 1) / n if n > 1 else 0.0
+    wire = frac * payload_bytes
+    steps = (n - 1) if n > 1 else 0
+    return ExpectedCost(verb=verb, mode="fp32", schedule="monolithic",
+                        n=n, payload_bytes=payload_bytes, wire_bytes=wire,
+                        steps=steps, busbw_factor=busbw_factor(verb, n))
+
+
+def expected_hierarchical(payload_bytes: int, n_local: int, n_cross: int,
+                          *, itemsize: int = 4) -> ExpectedCost:
+    """Two-tier allreduce (ops/hierarchical.py):
+    reduce_scatter@local -> all_reduce@cross -> all_gather@local.
+
+    Per chip: the local tier carries a reduce-scatter plus an allgather
+    of the full payload B (2 * (n_l-1)/n_l * B); the cross tier carries
+    a full allreduce of the local shard B/n_l (2 * (n_c-1)/n_c * B/n_l).
+    """
+    if n_local < 1 or n_cross < 1:
+        raise ValueError("tier sizes must be >= 1")
+    B = float(payload_bytes)
+    fl = (n_local - 1) / n_local if n_local > 1 else 0.0
+    fc = (n_cross - 1) / n_cross if n_cross > 1 else 0.0
+    local = TierCost(wire_bytes=2.0 * fl * B,
+                     steps=2 * (n_local - 1) if n_local > 1 else 0)
+    cross = TierCost(wire_bytes=2.0 * fc * (B / n_local),
+                     steps=2 * (n_cross - 1) if n_cross > 1 else 0)
+    n = n_local * n_cross
+    return ExpectedCost(
+        verb="allreduce", mode="fp32", schedule="hier", n=n,
+        payload_bytes=payload_bytes,
+        wire_bytes=local.wire_bytes + cross.wire_bytes,
+        steps=local.steps + cross.steps,
+        busbw_factor=busbw_factor("allreduce", n),
+        tiers={"local": local, "cross": cross})
+
+
+class PerfModel:
+    """Process-wide expected-vs-achieved tracker behind the
+    ``hvd_perf_*`` gauges.  Fed by the engine, the sched executor, the
+    hierarchical path and the benchmarks; configured (link model) from
+    ``hvd.init()``."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._link_gbs = 0.0          # 0 = rolling-peak calibration
+        self._link_latency_us = 1.0
+        self._peaks: dict = {}        # (verb, tier) -> best busbw GB/s
+        self._last: dict = {}         # (verb, mode, schedule, tier) -> row
+
+    def configure(self, *, link_gbs: float = 0.0,
+                  link_latency_us: float = 1.0) -> None:
+        with self._lock:
+            self._link_gbs = float(link_gbs)
+            self._link_latency_us = float(link_latency_us)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._peaks.clear()
+            self._last.clear()
+
+    # -- core -------------------------------------------------------------
+
+    def record(self, cost: ExpectedCost, seconds: float, *,
+               tier: str = "flat") -> Optional[dict]:
+        """Fold one achieved timing against its expected cost; returns
+        the attribution row (also kept for :meth:`summary`).  n<=1 or
+        degenerate timings are ignored — there is no wire to model."""
+        if cost.n <= 1 or seconds <= 0 or cost.payload_bytes <= 0:
+            return None
+        achieved_busbw = (cost.busbw_factor * cost.payload_bytes
+                          / seconds) / 1e9
+        with self._lock:
+            link_gbs = self._link_gbs
+            latency_us = self._link_latency_us
+            if link_gbs > 0:
+                expected_s = cost.expected_seconds(link_gbs, latency_us)
+                expected_busbw = (cost.busbw_factor * cost.payload_bytes
+                                  / expected_s) / 1e9
+                efficiency = expected_s / seconds
+                basis = "link"
+            else:
+                pk = self._peaks.get((cost.verb, tier), 0.0)
+                pk = max(pk, achieved_busbw)
+                self._peaks[(cost.verb, tier)] = pk
+                expected_busbw = pk
+                efficiency = achieved_busbw / pk if pk > 0 else 0.0
+                basis = "peak"
+            row = {
+                "verb": cost.verb, "mode": cost.mode,
+                "schedule": cost.schedule, "tier": tier,
+                "n": cost.n, "payload_bytes": cost.payload_bytes,
+                "expected_wire_bytes": cost.wire_bytes,
+                "expected_steps": cost.steps,
+                "seconds": seconds,
+                "achieved_busbw_gbs": achieved_busbw,
+                "expected_busbw_gbs": expected_busbw,
+                "efficiency": efficiency,
+                "basis": basis,
+            }
+            self._last[(cost.verb, cost.mode, cost.schedule, tier)] = row
+        lbl = dict(verb=cost.verb, mode=cost.mode,
+                   schedule=cost.schedule, tier=tier)
+        _m_eff.labels(**lbl).set(efficiency)
+        _m_achieved.labels(**lbl).set(achieved_busbw)
+        _m_expected.labels(**lbl).set(expected_busbw)
+        _m_obs.labels(verb=cost.verb).inc()
+        return row
+
+    # -- call-site entry points ------------------------------------------
+
+    def observe(self, verb: str, payload_bytes: int, n: int,
+                seconds: float, *, mode: str = "fp32",
+                schedule: str = "monolithic", chunks: int = 1,
+                block: int = 512, itemsize: int = 4) -> Optional[dict]:
+        """One fenced/monolithic timing (engine dispatch or bench loop)."""
+        try:
+            if verb in ("allreduce", "grouped_allreduce",
+                        "adasum_allreduce"):
+                cost = expected_allreduce(
+                    payload_bytes, n, mode=mode, chunks=chunks,
+                    block=block, itemsize=itemsize)
+                if schedule not in ("", "monolithic") and chunks == 1:
+                    cost = dataclasses.replace(cost, schedule=schedule)
+            else:
+                cost = expected_collective(verb, payload_bytes, n,
+                                           itemsize=itemsize)
+            return self.record(cost, seconds)
+        except Exception:
+            return None  # telemetry must never break the dispatch path
+
+    def observe_schedule(self, *, descriptor: str, mode: str,
+                         payload_bytes: int, n: int, chunks: int,
+                         comm_windows, compute_windows,
+                         block: int = 512,
+                         itemsize: int = 4) -> Optional[dict]:
+        """Achieved timing for a decomposed rs_ag schedule, from the
+        executor's per-step dispatch windows.
+
+        The achieved wall-clock is the union span of all windows (first
+        open to last close) — the host-observed in-flight time of the
+        whole pipeline; per-chunk comm windows additionally yield the
+        chunk-imbalance straggler gauge (slowest chunk / mean chunk).
+        """
+        try:
+            spans = list(comm_windows) + list(compute_windows)
+            if not spans:
+                return None
+            t0 = min(s[0] for s in spans)
+            t1 = max(s[1] for s in spans)
+            seconds = t1 - t0
+            cost = expected_allreduce(
+                payload_bytes, n, mode=mode, chunks=max(1, chunks),
+                block=block, itemsize=itemsize)
+            if descriptor:
+                cost = dataclasses.replace(cost, schedule=descriptor)
+            row = self.record(cost, seconds)
+            durs = [max(0.0, b - a) for a, b in comm_windows]
+            if len(durs) >= 2:
+                mean = sum(durs) / len(durs)
+                if mean > 0:
+                    _m_imbalance.set(max(durs) / mean)
+            return row
+        except Exception:
+            return None
+
+    def observe_tiers(self, payload_bytes: int, n_local: int,
+                      n_cross: int, seconds: float, *,
+                      tier_seconds: Optional[dict] = None) -> dict:
+        """Two-tier attribution (ROADMAP item 3's straggler feed).
+
+        With measured per-tier times, excess = achieved - expected per
+        tier directly; without, the total excess over the model is
+        apportioned by each tier's expected share — coarse, but it
+        points at the tier that dominates the bound, which is the
+        decision the ICI/DCN lowering needs.
+        """
+        cost = expected_hierarchical(payload_bytes, n_local, n_cross)
+        total_wire = max(1e-12, cost.wire_bytes)
+        out = {}
+        with self._lock:
+            link_gbs = self._link_gbs
+            latency_us = self._link_latency_us
+        for name, tc in cost.tiers.items():
+            frac = tc.wire_bytes / total_wire
+            _m_tier_frac.labels(tier=name).set(frac)
+            # Expected seconds on this tier: link model when configured,
+            # else the tier's proportional share of the achieved total
+            # (excess then only shows up with measured per-tier times).
+            if link_gbs > 0:
+                exp_s = (tc.steps * latency_us * 1e-6
+                         + tc.wire_bytes / (link_gbs * 1e9))
+            else:
+                exp_s = frac * max(0.0, seconds)
+            achieved_s = (tier_seconds or {}).get(name, exp_s if
+                                                  link_gbs <= 0 else
+                                                  frac * seconds)
+            excess = achieved_s - exp_s
+            _m_tier_excess.labels(tier=name).set(excess)
+            out[name] = {"expected_fraction": frac,
+                         "expected_wire_bytes": tc.wire_bytes,
+                         "steps": tc.steps, "excess_seconds": excess}
+        self.record(cost, seconds, tier="hier")
+        return out
+
+    # -- views ------------------------------------------------------------
+
+    def summary(self) -> list:
+        """Latest attribution row per (verb, mode, schedule, tier)."""
+        with self._lock:
+            return [dict(v) for _, v in sorted(self._last.items())]
+
+
+#: process-wide model instance every call site feeds
+MODEL = PerfModel()

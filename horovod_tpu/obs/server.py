@@ -111,10 +111,18 @@ def _make_handler(registry: MetricRegistry):
                 else:
                     body = export.to_json(snap)
                     ctype = "application/json"
+            elif path in ("/profz", "/profz.json"):
+                from .prof import PROFILER
+                if path == "/profz":
+                    body = PROFILER.render_text()
+                    ctype = "text/plain; charset=utf-8"
+                else:
+                    body = json.dumps(PROFILER.snapshot())
+                    ctype = "application/json"
             else:
                 self.send_error(
                     404, "try /metrics, /metrics.json, /cluster, "
-                         "/cluster.json or /healthz")
+                         "/cluster.json, /profz, /profz.json or /healthz")
                 return
             payload = body.encode("utf-8")
             self.send_response(200)

@@ -41,6 +41,7 @@ from . import reduction as _R
 from .. import chaos
 from ..obs import REGISTRY as _obs
 from ..obs import flightrec as _frec
+from ..obs import perfmodel as _perf
 from ..obs import trace as _trace
 from ..utils import logging as hvd_logging
 
@@ -790,14 +791,37 @@ class CollectiveEngine:
             # every waiter — the elastic recovery trigger); die is the
             # injected rank death the chaos CI scenario rides.
             chaos.fire("dispatch")
+            t_disp = time.monotonic()
             with TraceAnnotation(f"hvd.{group[0].verb}:{label}"):
                 results = self._dispatch(group)
+            t_disp = time.monotonic() - t_disp
             if tl is not None and tl.enabled:
                 for e in group:
                     tl.end_activity(e.name)
                     e.tl_phase = ""
             if group[0].verb == "allreduce":
                 _m_fusion_batch.observe(len(group))
+            e0 = group[0]
+            if not e0.schedule:
+                # Expected-vs-achieved feed for monolithic dispatches
+                # (decomposed allreduces are observed by the sched
+                # executor itself, from its per-step windows).  The host
+                # dispatch window is the achieved timing — async
+                # dispatch makes it a lower bound, consistent within
+                # each (verb, mode, schedule) series.
+                try:
+                    itemsize = int(e0.payload.dtype.itemsize)
+                except AttributeError:
+                    itemsize = 4
+                # _entry_bytes counts the device-stacked array; the ring
+                # model wants the per-rank logical payload (what the
+                # sched executor also accounts: shape[1:]).
+                nranks = max(1, self._state.size)
+                _perf.MODEL.observe(
+                    e0.verb,
+                    sum(self._entry_bytes(e) for e in group) // nranks,
+                    nranks, t_disp,
+                    mode=e0.precision or "fp32", itemsize=itemsize)
             _frec.RECORDER.record(
                 "dispatch", name=label, verb=group[0].verb,
                 tensors=len(group),

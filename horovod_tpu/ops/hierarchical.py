@@ -75,6 +75,8 @@ def hierarchical_allreduce(x: jax.Array, mesh: Mesh, *,
     """Standalone entry: x is a per-device-stacked array
     ``[n_cross, n_local, *shape]`` sharded over (cross, local); every
     device contributes its slice and receives the full reduction."""
+    import time
+
     fn = shard_map(
         lambda v: hierarchical_allreduce_local(
             v[0, 0], local_axis=local_axis, cross_axis=cross_axis,
@@ -83,7 +85,17 @@ def hierarchical_allreduce(x: jax.Array, mesh: Mesh, *,
         in_specs=P(cross_axis, local_axis),
         out_specs=P(cross_axis, local_axis),
         check_vma=False)
-    return jax.jit(fn)(x)
+    t0 = time.monotonic()
+    out = jax.jit(fn)(x)
+    # Per-tier expected-cost attribution (ROADMAP item 3's straggler
+    # feed): the host dispatch window against the two-tier wire model.
+    from ..obs import perfmodel as _perf
+    n_local = mesh.shape[local_axis]
+    n_cross = mesh.shape[cross_axis]
+    per_chip = int(x.size // max(1, n_local * n_cross) * x.dtype.itemsize)
+    _perf.MODEL.observe_tiers(per_chip, n_local, n_cross,
+                              time.monotonic() - t0)
+    return out
 
 
 def hierarchical_allgather_local(v: jax.Array, *, local_axis: str,

@@ -46,6 +46,7 @@ from ...jaxcompat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...obs import REGISTRY as _obs
+from ...obs import perfmodel as _perf
 from .. import reduction as R
 from .lower import chunk_layout, parse_descriptor
 
@@ -412,4 +413,12 @@ def execute_allreduce(xs: Sequence[Any], op, *, descriptor: str,
     for c in range(k):
         _close("ag", c)
     _m_overlap.set(_overlap_fraction(windows["comm"], windows["compute"]))
+    # Feed the same dispatch windows into the expected-vs-achieved model:
+    # the union span is the host-observed in-flight time of the whole
+    # pipeline, the per-chunk comm windows give straggler attribution.
+    _perf.MODEL.observe_schedule(
+        descriptor=f"rs_ag:{chunks}", mode=mode,
+        payload_bytes=total * dtype.itemsize, n=n, chunks=k,
+        comm_windows=windows["comm"], compute_windows=windows["compute"],
+        block=block, itemsize=dtype.itemsize)
     return list(results)

@@ -97,6 +97,60 @@ def _serving_trace_e2e() -> None:
     assert {e["ph"] for e in links} >= {"s", "f"}, links
 
 
+def _perf_observatory(me: int, n: int) -> None:
+    """Drive the three acceptance verbs — monolithic allreduce,
+    decomposed rs_ag allreduce, alltoall — through the real negotiated
+    engine, then assert the perf model's expected-vs-achieved
+    attribution locally; rank 0 re-asserts it rank-labeled on /cluster
+    (the gauges ride the same published snapshot)."""
+    from horovod_tpu.obs import perfmodel
+
+    cfg = hvd.global_state().config
+    numel = 4096
+    payload = numel * 4
+
+    def _ar(tag):
+        h = hvd.allreduce_async(
+            hvd.from_local(np.ones((1, numel), np.float32)),
+            hvd.Sum, name=f"perf.{tag}")
+        assert np.ravel(hvd.to_numpy(hvd.synchronize(h)))[0] == float(n)
+
+    _ar("mono")
+    cfg.sched_mode, cfg.sched_chunks = "decomposed", 2
+    try:
+        _ar("dec")
+    finally:
+        cfg.sched_mode = "monolithic"
+    a2a = hvd.alltoall([np.full((n, 3), float(me + 1), np.float32)],
+                       splits=np.array([[1] * n], np.int32))
+    assert np.asarray(a2a[0]).shape == (n, 3), a2a
+
+    # Local attribution: one summary row per (verb, schedule), with the
+    # analytic ring wire bytes (2*(n-1)/n of the payload for allreduce).
+    rows = {(r["verb"], r["schedule"]): r for r in perfmodel.MODEL.summary()}
+    ar = rows[("allreduce", "monolithic")]
+    assert ar["n"] == n and ar["payload_bytes"] == payload, ar
+    assert ar["expected_wire_bytes"] == 2 * (n - 1) / n * payload, ar
+    assert ar["expected_steps"] == 2 * (n - 1), ar
+    dec = rows[("allreduce", "rs_ag:2")]
+    assert dec["expected_wire_bytes"] == ar["expected_wire_bytes"], dec
+    assert dec["expected_steps"] == 2 * (n - 1) * 2, dec
+    a2 = rows[("alltoall", "monolithic")]
+    assert a2["expected_wire_bytes"] == (n - 1) / n * a2["payload_bytes"]
+    for r in rows.values():
+        assert 0.0 < r["efficiency"] <= 1.0 and r["basis"] == "peak", r
+    # ...and on the local exposition (label order is alphabetical).
+    text = hvd.metrics("prometheus")
+    for want in (
+            'hvd_perf_efficiency{mode="fp32",schedule="monolithic",'
+            'tier="flat",verb="allreduce"}',
+            'hvd_perf_efficiency{mode="fp32",schedule="rs_ag:2",'
+            'tier="flat",verb="allreduce"}',
+            'hvd_perf_efficiency{mode="fp32",schedule="monolithic",'
+            'tier="flat",verb="alltoall"}'):
+        assert want in text, (want, text)
+
+
 def cluster_mode(me: int, n: int) -> int:
     from horovod_tpu.obs import slo, trace
 
@@ -119,6 +173,7 @@ def cluster_mode(me: int, n: int) -> int:
         sp.child("QUEUE").end()
         sp.end()
         assert trace.export()["trace_id"] == sp.trace_id
+    _perf_observatory(me, n)
     assert aggregate.publish_now(), "publisher not armed or KV unreachable"
 
     if me == 0:
@@ -176,6 +231,17 @@ def cluster_mode(me: int, n: int) -> int:
         assert 'hvd_traces_total{rank="0",sampled="true"} 1' in text, text
         assert 'hvd_traces_total{rank="1",sampled="true"} 1' in text, text
         assert 'hvd_traces_total{sampled="true"} 2' in text, text
+        # Perf-model efficiency gauges from BOTH ranks, per verb and
+        # schedule — the acceptance surface for expected-vs-achieved
+        # attribution (a straggler = one rank's efficiency under its
+        # peers' on the same series).
+        for rk in ("0", "1"):
+            for verb, sched in (("allreduce", "monolithic"),
+                                ("allreduce", "rs_ag:2"),
+                                ("alltoall", "monolithic")):
+                assert (f'hvd_perf_efficiency{{mode="fp32",rank="{rk}",'
+                        f'schedule="{sched}",tier="flat",verb="{verb}"}}'
+                        ) in text, (verb, sched, rk, text)
         # /healthz on the same endpoint: ready while the runtime is up.
         srv2 = server.MetricsServer(0, addr="127.0.0.1")
         try:

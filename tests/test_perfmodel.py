@@ -1,0 +1,254 @@
+"""Perf-model math (obs/perfmodel) and the sampling profiler (obs/prof).
+
+The model's stdlib wire accounting must agree with the engine's own
+``ops.reduction.ring_wire_bytes`` (the perfmodel docstring's contract —
+the duplication exists only because the obs plane imports without jax),
+and the expected-cost walk must match the hand-derived ring formulas per
+verb x wire mode x chunking, plus the hierarchical two-tier split.
+"""
+
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.obs import REGISTRY, perfmodel, server
+from horovod_tpu.obs.perfmodel import (
+    PerfModel, busbw_factor, expected_allreduce, expected_collective,
+    expected_hierarchical, wire_per_elem)
+from horovod_tpu.obs.prof import SamplingProfiler
+
+MODES = ("fp32", "bf16", "fp16", "int8", "fp8")
+
+
+# -- wire accounting agrees with the engine's ----------------------------
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("n", (2, 4, 8))
+@pytest.mark.parametrize("nbytes", (4096, 1 << 20, 1 << 22))
+def test_wire_bytes_agree_with_ring_wire_bytes(mode, n, nbytes):
+    from horovod_tpu.ops import reduction as R
+    block = 512
+    cost = expected_allreduce(nbytes, n, mode=mode, block=block)
+    want = R.ring_wire_bytes(mode, nbytes, n, block, itemsize=4)
+    assert cost.wire_bytes == pytest.approx(want, rel=1e-9), (mode, n)
+
+
+def test_wire_per_elem_widths():
+    # fp32 moves each element twice at full width; casts at half; quant
+    # at ~3 bytes + the per-block scale amortized.
+    assert wire_per_elem("fp32") == 8.0
+    assert wire_per_elem("bf16") == 4.0 and wire_per_elem("fp16") == 4.0
+    assert wire_per_elem("int8", block=512) == 3.0 + 8.0 / 512
+    assert wire_per_elem("fp8", block=128) == 3.0 + 8.0 / 128
+
+
+# -- expected-cost walk, per verb / chunking / hierarchy -----------------
+
+def test_expected_allreduce_monolithic_ring_math():
+    cost = expected_allreduce(1 << 20, 8, mode="fp32")
+    numel = (1 << 20) / 4
+    assert cost.wire_bytes == pytest.approx((7 / 8) * 8.0 * numel)
+    assert cost.steps == 2 * 7
+    assert cost.schedule == "monolithic"
+    assert cost.busbw_factor == pytest.approx(2 * 7 / 8)
+
+
+@pytest.mark.parametrize("k", (2, 4, 8))
+def test_chunking_multiplies_steps_not_wire(k):
+    mono = expected_allreduce(1 << 20, 8, mode="int8", chunks=1)
+    dec = expected_allreduce(1 << 20, 8, mode="int8", chunks=k)
+    assert dec.wire_bytes == pytest.approx(mono.wire_bytes)
+    assert dec.steps == mono.steps * k
+    assert dec.schedule == f"rs_ag:{k}"
+
+
+@pytest.mark.parametrize("verb", ("allgather", "alltoall",
+                                  "reducescatter", "broadcast"))
+def test_single_phase_verbs(verb):
+    cost = expected_collective(verb, 1 << 20, 4)
+    assert cost.wire_bytes == pytest.approx((3 / 4) * (1 << 20))
+    assert cost.steps == 3
+    assert cost.busbw_factor == pytest.approx(3 / 4)
+
+
+def test_single_rank_has_no_wire():
+    assert busbw_factor("allreduce", 1) == 0.0
+    cost = expected_allreduce(1 << 20, 1)
+    assert cost.wire_bytes == 0.0 and cost.steps == 0
+    # ...and the model refuses to score it (nothing to attribute).
+    assert PerfModel().record(cost, 1.0) is None
+
+
+def test_hierarchical_two_tier_split():
+    B = 1 << 22
+    cost = expected_hierarchical(B, n_local=4, n_cross=2)
+    local, cross = cost.tiers["local"], cost.tiers["cross"]
+    # Local: rs + ag of the full payload over 4; cross: full allreduce
+    # of the 1/4 shard over 2.
+    assert local.wire_bytes == pytest.approx(2 * (3 / 4) * B)
+    assert cross.wire_bytes == pytest.approx(2 * (1 / 2) * (B / 4))
+    assert local.steps == 2 * 3 and cross.steps == 2 * 1
+    assert cost.wire_bytes == pytest.approx(
+        local.wire_bytes + cross.wire_bytes)
+    assert cost.n == 8 and cost.schedule == "hier"
+
+
+# -- efficiency scoring --------------------------------------------------
+
+def test_peak_basis_self_calibrates():
+    m = PerfModel()
+    cost = expected_allreduce(1 << 20, 8)
+    first = m.record(cost, 0.010)
+    assert first["basis"] == "peak" and first["efficiency"] == 1.0
+    slower = m.record(cost, 0.020)
+    assert slower["efficiency"] == pytest.approx(0.5)
+    faster = m.record(cost, 0.005)    # new peak resets the denominator
+    assert faster["efficiency"] == 1.0
+
+
+def test_link_basis_scores_against_configured_model():
+    m = PerfModel()
+    m.configure(link_gbs=1.0, link_latency_us=0.0)
+    cost = expected_allreduce(1 << 20, 8, mode="fp32")
+    exp_s = cost.expected_seconds(1.0, 0.0)
+    row = m.record(cost, exp_s * 2)
+    assert row["basis"] == "link"
+    assert row["efficiency"] == pytest.approx(0.5)
+    assert m.record(cost, exp_s)["efficiency"] == pytest.approx(1.0)
+
+
+def test_observe_schedule_union_span_and_imbalance():
+    m = PerfModel()
+    row = m.observe_schedule(
+        descriptor="rs_ag:2", mode="fp32", payload_bytes=1 << 20, n=4,
+        chunks=2, comm_windows=[(0.0, 0.010), (0.012, 0.040)],
+        compute_windows=[(0.010, 0.012)])
+    assert row["schedule"] == "rs_ag:2"
+    assert row["seconds"] == pytest.approx(0.040)   # union of all spans
+    imb = REGISTRY.get("hvd_perf_chunk_imbalance")
+    # slowest chunk 28ms vs mean 19ms
+    assert imb.value == pytest.approx(0.028 / 0.019, rel=1e-6)
+
+
+def test_observe_tiers_attribution():
+    m = PerfModel()
+    m.configure(link_gbs=1.0, link_latency_us=0.0)
+    out = m.observe_tiers(1 << 22, 4, 2, seconds=0.1,
+                          tier_seconds={"local": 0.08, "cross": 0.02})
+    # Expected fractions follow the wire split: local 6/7, cross 1/7.
+    assert out["local"]["expected_fraction"] == pytest.approx(6 / 7)
+    assert out["cross"]["expected_fraction"] == pytest.approx(1 / 7)
+    exp_local = (2 * (3 / 4) * (1 << 22)) / 1e9
+    assert out["local"]["excess_seconds"] == pytest.approx(
+        0.08 - exp_local, rel=1e-6)
+
+
+def test_observe_never_raises_and_exports_gauges():
+    m = PerfModel()
+    assert m.observe("allreduce", -5, "bogus", None) is None
+    row = m.observe("alltoall", 1 << 16, 4, 0.001)
+    assert row is not None
+    fam = REGISTRY.get("hvd_perf_efficiency")
+    labels = [s["labels"] for s in fam._samples()]
+    assert any(lb.get("verb") == "alltoall" for lb in labels), labels
+
+
+# -- sampling profiler ---------------------------------------------------
+
+def test_profiler_samples_busy_thread_and_bounds_table():
+    prof = SamplingProfiler(hz=200.0, max_stacks=64, ring=16)
+    stop = threading.Event()
+
+    def _spin_hot_loop():
+        while not stop.is_set():
+            sum(range(200))
+
+    t = threading.Thread(target=_spin_hot_loop, name="hotspot",
+                         daemon=True)
+    t.start()
+    try:
+        assert prof.start()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            hot = prof.hot_stacks(limit=50)
+            if any(r["thread"] == "hotspot" and
+                   any("_spin_hot_loop" in fr for fr in r["stack"])
+                   for r in hot):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError(f"busy thread never sampled: {hot}")
+    finally:
+        prof.stop()
+        stop.set()
+        t.join()
+    assert not prof.running
+    snap = prof.snapshot()
+    assert snap["samples"] > 0 and not snap["enabled"]
+    assert len(snap["hot_stacks"]) <= 64
+    fs = prof.flight_summary()
+    assert len(fs["ring"]) <= 16 and fs["hot_stacks"]
+
+
+def test_profz_routes_on_obs_server():
+    hvd.init()
+    srv = server.MetricsServer(0, addr="127.0.0.1")
+    try:
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/profz", timeout=10
+        ).read().decode()
+        assert "sampling profiler" in text and "hot stacks" in text
+        import json
+        snap = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/profz.json", timeout=10
+        ).read().decode())
+        assert {"enabled", "hz", "hot_stacks", "engine_phases"} <= \
+            set(snap)
+    finally:
+        srv.close()
+
+
+def test_flightrec_bundle_carries_profiler_ring(tmp_path):
+    from horovod_tpu.obs import flightrec
+    from horovod_tpu.obs.prof import PROFILER
+    PROFILER.configure(hz=100.0)
+    was = PROFILER.running
+    PROFILER.start()
+    try:
+        time.sleep(0.1)
+        path = flightrec.RECORDER.dump(str(tmp_path / "bundle.json"),
+                                       reason="test")
+    finally:
+        if not was:
+            PROFILER.stop()
+    import json
+    with open(path) as fh:
+        bundle = json.load(fh)
+    prof = bundle["profile"]
+    assert prof["enabled"] and prof["hz"] == 100.0
+    assert prof["ring"], "recent stack ring missing from bundle"
+    assert all({"t", "threads"} <= set(e) for e in prof["ring"])
+
+
+def test_perf_gauges_reach_metrics_endpoint_after_collective():
+    """Single-process rig: one allreduce through the engine must land
+    hvd_perf_efficiency{verb=allreduce,schedule=monolithic} on /metrics
+    (the np=2 /cluster half lives in mp_obs_worker).  The async verb is
+    the engine dispatch path the model instruments; the sync wrapper is
+    a pure in-jit collective with no host-side dispatch to time."""
+    hvd.init()
+    n = hvd.size()
+    if n <= 1:
+        pytest.skip("needs a multi-device rig")
+    h = hvd.allreduce_async(
+        hvd.per_rank([np.ones((1024,), np.float32) for _ in range(n)]),
+        hvd.Sum, name="perf_gauge_probe")
+    out = hvd.synchronize(h)
+    assert float(np.ravel(hvd.to_numpy(out))[0]) == float(n)
+    text = hvd.metrics("prometheus")
+    assert ('hvd_perf_efficiency{mode="fp32",schedule="monolithic",'
+            'tier="flat",verb="allreduce"}') in text, text

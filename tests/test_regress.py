@@ -1,0 +1,218 @@
+"""Bench regression sentinel (benchmarks/regress.py).
+
+Pure-stdlib code under test — no jax, no rig.  Covers the gate's three
+contractual behaviors (improvement passes, regression fails, device
+kinds never cross-compare), the normalized-trajectory build from
+synthetic BENCH/measured files, the allowlist, the live ``--extra``
+ingestion, and the ``--inject`` self-test through the real CLI.
+"""
+
+import json
+
+import pytest
+
+from benchmarks import regress
+
+
+def _rows(values, metric="tok_per_sec", kind="cpu", hib=True):
+    return [{"round": f"r{i:02d}", "order": i * 1000, "metric": metric,
+             "value": v, "unit": "", "device_kind": kind,
+             "higher_is_better": hib, "source": "test"}
+            for i, v in enumerate(values)]
+
+
+def _by_status(results):
+    out = {}
+    for r in results:
+        out.setdefault(r["status"], []).append(r)
+    return out
+
+
+# -- the gate ------------------------------------------------------------
+
+def test_improvement_passes_and_is_reported():
+    res = regress.check_series(_rows([1.0, 1.0, 1.0, 2.0]))
+    assert [r["status"] for r in res] == ["improved"]
+
+
+def test_regression_fails():
+    [r] = regress.check_series(_rows([10.0, 10.0, 10.0, 5.0]))
+    assert r["status"] == "regressed"
+    assert r["delta_pct"] == pytest.approx(-50.0)
+    assert r["baseline"] == pytest.approx(10.0)
+
+
+def test_small_wobble_within_threshold_is_ok():
+    [r] = regress.check_series(_rows([10.0, 10.1, 9.9, 9.0]))
+    assert r["status"] == "ok"          # -10% < the 25% gate
+
+
+def test_lower_is_better_flags_increases():
+    [r] = regress.check_series(_rows([100.0, 100.0, 180.0],
+                                     metric="p99_latency_ms", hib=False))
+    assert r["status"] == "regressed" and r["delta_pct"] > 0
+
+
+def test_mixed_device_kinds_never_cross_compare():
+    # Same metric, TPU history then a CPU point 50x lower: two separate
+    # series by construction, each too short to judge — NOT a regression.
+    rows = (_rows([100.0, 101.0, 99.0], kind="TPU v5 lite")
+            + _rows([2.0], kind="cpu"))
+    res = regress.check_series(rows)
+    by = {(r["metric"], r["device_kind"]): r["status"] for r in res}
+    assert by[("tok_per_sec", "cpu")] == "single"
+    assert by[("tok_per_sec", "TPU v5 lite")] == "ok"
+    assert not _by_status(res).get("regressed")
+
+
+def test_rolling_median_window_forgets_ancient_peaks():
+    # A one-off spike 6 rounds ago must not poison today's baseline.
+    vals = [50.0] + [10.0] * 6 + [9.0]
+    [r] = regress.check_series(_rows(vals), window=5)
+    assert r["status"] == "ok" and r["baseline"] == pytest.approx(10.0)
+
+
+def test_allowlist_downgrades_to_allowed():
+    allow = [{"metric": "tok_per_sec", "device_kind": "cpu",
+              "reason": "container changed"}]
+    [r] = regress.check_series(_rows([10.0, 10.0, 3.0]), allowlist=allow)
+    assert r["status"] == "allowed" and r["reason"] == "container changed"
+    # Wildcard device kind matches too; a different metric does not.
+    [r2] = regress.check_series(
+        _rows([10.0, 10.0, 3.0]),
+        allowlist=[{"metric": "tok_per_sec", "device_kind": "*",
+                    "reason": "any kind"}])
+    assert r2["status"] == "allowed"
+    [r3] = regress.check_series(
+        _rows([10.0, 10.0, 3.0]),
+        allowlist=[{"metric": "other", "reason": "no"}])
+    assert r3["status"] == "regressed"
+
+
+def test_only_rounds_restricts_judgement_to_live_series():
+    hist = _rows([10.0, 10.0, 10.0])
+    live = [{"round": "live", "order": 10 ** 9, "metric": "tok_per_sec",
+             "value": 2.0, "unit": "", "device_kind": "cpu",
+             "higher_is_better": True, "source": "sweep"}]
+    res = regress.check_series(hist + live, only_rounds={"live"})
+    assert len(res) == 1 and res[0]["status"] == "regressed"
+    # Without live rows nothing is judged at all.
+    assert regress.check_series(hist, only_rounds={"live"}) == []
+
+
+# -- normalization -------------------------------------------------------
+
+def test_build_trajectory_from_synthetic_history(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "parsed": {"metric": "train_tok", "value": 100.0, "unit": "tok/s",
+                   "device_kind": "cpu", "mfu": 0.5}}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "parsed": {"metric": "train_tok", "value": 120.0, "unit": "tok/s",
+                   "device_kind": "cpu"},
+        "rows": [{"op": "allreduce", "bytes": 4096, "ranks": 8,
+                  "busbw_GBs": 0.5}]}))
+    measured = tmp_path / "measured.jsonl"
+    measured.write_text(json.dumps(
+        {"metric": "train_tok", "value": 130.0, "unit": "tok/s",
+         "device_kind": "cpu"}) + "\n" + "not json\n")
+    traj = regress.build_trajectory(repo=str(tmp_path),
+                                    measured=str(measured))
+    series = {(r["metric"], r["device_kind"]) for r in traj["rows"]}
+    assert ("train_tok", "cpu") in series
+    assert ("train_tok_mfu", "cpu") in series
+    assert ("allreduce_fp32_monolithic_busbw_GBs@4KB",
+            "cpu-rig-np8") in series
+    tt = [r for r in traj["rows"]
+          if r["metric"] == "train_tok" and r["device_kind"] == "cpu"]
+    assert [r["value"] for r in sorted(tt, key=lambda r: r["order"])] \
+        == [100.0, 120.0, 130.0]   # rounds first, measured after
+    assert traj["rounds"] == ["measured", "r01", "r02"]
+
+
+def test_ingest_extra_parses_sweep_rows_only(tmp_path):
+    sweep = tmp_path / "sweep.jsonl"
+    sweep.write_text("\n".join([
+        json.dumps({"op": "allreduce", "bytes": 1 << 20, "ranks": 8,
+                    "wire_precision": "fp32", "busbw_GBs": 0.4,
+                    "model_efficiency": 1.0}),
+        json.dumps({"metric": "allreduce_busbw_peak", "value": 0.4}),
+        "garbage",
+    ]))
+    rows = regress.ingest_extra(str(sweep))
+    assert len(rows) == 1
+    assert rows[0]["metric"] == "allreduce_fp32_monolithic_busbw_GBs@1MB"
+    assert rows[0]["device_kind"] == "cpu-rig-np8"
+    assert rows[0]["round"] == "live"
+
+
+# -- the CLI, end to end -------------------------------------------------
+
+def _write_traj(tmp_path, values):
+    path = tmp_path / "traj.json"
+    path.write_text(json.dumps({"rows": _rows(values)}))
+    return str(path)
+
+
+def test_cli_check_passes_then_inject_fails(tmp_path, capsys):
+    path = _write_traj(tmp_path, [10.0, 10.0, 10.5])
+    assert regress.main(["--check", "--trajectory", path]) == 0
+    assert regress.main(["--check", "--trajectory", path,
+                         "--inject", "tok_per_sec"]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out and "FAIL" in out
+
+
+def test_cli_inject_explicit_value_and_kind(tmp_path):
+    path = _write_traj(tmp_path, [10.0, 10.0, 10.0])
+    assert regress.main(["--check", "--trajectory", path,
+                         "--inject", "tok_per_sec@cpu=1.0"]) == 1
+    with pytest.raises(SystemExit):
+        regress.main(["--check", "--trajectory", path,
+                      "--inject", "no_such_metric"])
+
+
+def test_cli_inject_handles_at_sign_in_metric_names(tmp_path):
+    # Per-size sweep series contain '@' in the metric itself: an exact
+    # name match wins, and only a trailing '@kind' splits off.
+    rows = _rows([0.4, 0.4, 0.4],
+                 metric="allreduce_fp32_monolithic_busbw_GBs@1MB",
+                 kind="cpu-rig-np8")
+    path = tmp_path / "traj.json"
+    path.write_text(json.dumps({"rows": rows}))
+    assert regress.main([
+        "--check", "--trajectory", str(path),
+        "--inject", "allreduce_fp32_monolithic_busbw_GBs@1MB"]) == 1
+    assert regress.main([
+        "--check", "--trajectory", str(path),
+        "--inject",
+        "allreduce_fp32_monolithic_busbw_GBs@1MB@cpu-rig-np8"]) == 1
+
+
+def test_cli_extra_gates_live_rows(tmp_path):
+    # History for the 1MB np8 series, then a live sweep 10x slower:
+    # fails even at the loose live threshold.
+    hist = _rows([0.40, 0.41, 0.39],
+                 metric="allreduce_fp32_monolithic_busbw_GBs@1MB",
+                 kind="cpu-rig-np8")
+    path = tmp_path / "traj.json"
+    path.write_text(json.dumps({"rows": hist}))
+    sweep = tmp_path / "sweep.jsonl"
+    sweep.write_text(json.dumps(
+        {"op": "allreduce", "bytes": 1 << 20, "ranks": 8,
+         "busbw_GBs": 0.04}) + "\n")
+    assert regress.main(["--check", "--trajectory", str(path),
+                         "--extra", str(sweep)]) == 1
+    # The same live value within the threshold passes.
+    sweep.write_text(json.dumps(
+        {"op": "allreduce", "bytes": 1 << 20, "ranks": 8,
+         "busbw_GBs": 0.35}) + "\n")
+    assert regress.main(["--check", "--trajectory", str(path),
+                         "--extra", str(sweep)]) == 0
+
+
+def test_committed_trajectory_is_fresh_and_passes():
+    """The acceptance gate itself: the committed BENCH_trajectory.json
+    must rebuild identically from BENCH_r*.json + measured.jsonl and
+    clear the regression check (historical drops are allowlisted with
+    reasons in benchmarks/regress_allow.json)."""
+    assert regress.main(["--check"]) == 0
