@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: ci native test mp-test examples bench baseline-table image \
-	autoscale-recovery perf-regress bench-trajectory
+	autoscale-recovery perf-regress bench-trajectory hierarchical-parity
 
 # The autoscale-recovery CI job standalone: np=4 MoE job, injected rank
 # death + SLO load spike => shrink to np=2, grow back to np=4.
@@ -25,6 +25,12 @@ horovod_tpu.serving"
 	$(PY) -m horovod_tpu.chaos.run --scenario router
 	$(PY) -m horovod_tpu.chaos.run --scenario autoscale
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# The hierarchical-parity CI job standalone: np=4 as a 2x2 two-tier
+# rig, chunked+tiered hier:2:2 schedule vs flat parity, quantized cross
+# hop, join/rebuild, and rank-labeled per-tier gauges on /cluster.
+hierarchical-parity:
+	$(PY) -m pytest "tests/test_runner.py::test_hvdrun_hierarchical_parity" -q
 
 # Regenerate BASELINE.md's measured table from benchmarks/measured.jsonl
 # (the jsonl is the source of truth; `--check` in CI fails on drift).

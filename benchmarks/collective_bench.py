@@ -44,6 +44,23 @@ work, so decomposed wall-clock there is dispatch-overhead-bound and does
 NOT improve; ``overlap_window`` is the number that transfers to a TPU
 whose async collectives fill it.  ``--out`` writes the schedule sweep as
 a BENCH_rXX.json-style record.
+
+Hierarchy (``--hierarchy``): treats the mesh as two tiers (np=4 as 2x2
+by default, split from ``HVDTPU_HIERARCHICAL_LOCAL_SIZE`` or config)
+and sweeps flat vs the tiered monolithic kernel (ops/hierarchical.py)
+vs the chunked+tiered schedule (``hier:<n_local>:2``) with every wire
+mode on the cross hop.  Hier rows report ``local_wire_bytes`` /
+``cross_wire_bytes`` (analytic, obs/perfmodel.expected_hierarchical)
+and ``cross_wire_reduction`` vs the flat fp32 ring.
+
+The honest CPU-rig caveat, sharpened for this sweep: the rig's "DCN"
+is the same shared memory as its "ICI", so the defining two-tier win —
+the slow cross fabric carrying only ``1/n_local`` of the payload —
+CANNOT appear in wall-clock here (the tiered path just runs three
+collectives instead of one and measures slower).  The number that
+transfers to a real ICI/DCN pod is ``cross_wire_reduction``:
+``n_local x`` at fp32, ``~2.6 * n_local x`` with an int8 cross hop
+(EQuARX-style), asserted analytically per row.
 """
 
 from __future__ import annotations
@@ -68,7 +85,8 @@ def jax_device_get_first(x):
 
 def allreduce_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
                     dtype="float32", wire_precision: str = "fp32",
-                    schedule: str = "monolithic") -> dict:
+                    schedule: str = "monolithic",
+                    fence_each: bool = False) -> dict:
     """One allreduce bandwidth point on the current global mesh."""
     import jax
     import jax.numpy as jnp
@@ -101,10 +119,20 @@ def allreduce_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
     _fence(out)
     for _ in range(warmup):
         out = one()
+        if fence_each:
+            _fence(out)
     _fence(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = one()
+        if fence_each:
+            # The tiered paths launch several sub-programs per call;
+            # letting 20 of those pipeline unfenced starves XLA:CPU's
+            # cross_module rendezvous threads into deadlock.  Fencing
+            # each iteration caps in-flight work at one execution — it
+            # adds a readback per iter, which the rig absorbs (its
+            # numbers are dispatch-bound either way; module docstring).
+            _fence(out)
     _fence(out)
     dt = (time.perf_counter() - t0) / iters
     payload = numel * itemsize
@@ -118,14 +146,44 @@ def allreduce_busbw(nbytes: int, *, iters: int = 20, warmup: int = 3,
         row["schedule"] = resolved_sched or "monolithic"
         if resolved_sched:
             from horovod_tpu.ops.sched import executor as SE
-            k = len(S.chunk_layout(numel, n, S.parse_descriptor(
-                resolved_sched), resolved, cfg.quant_block_size))
+            hier = S.parse_hier_descriptor(resolved_sched)
+            kreq = hier[1] if hier else S.parse_descriptor(resolved_sched)
+            cross_mode = (SE.resolve_cross_mode(resolved, cfg)
+                          if hier else "")
+            mode_eff = resolved if resolved in R.QUANT_MODES else \
+                (cross_mode if cross_mode in R.QUANT_MODES else resolved)
+            k = len(S.chunk_layout(numel, n, kreq, mode_eff,
+                                   cfg.quant_block_size))
             # Analytic overlap window: with k chunks dispatched
             # interleaved, (k-1)/k of the communication can hide under
             # other chunks' compute on an async-collective backend.
             row["chunks"] = k
             row["overlap_window"] = round((k - 1) / k, 3)
             row["overlap_fraction"] = round(SE._m_overlap.value, 6)
+            if hier:
+                # Per-tier analytic wire accounting: the transferable
+                # number on a two-tier fabric is the cross (DCN) hop
+                # carrying 1/n_local of the payload at its own wire
+                # mode — the CPU rig's shared-memory "DCN" cannot show
+                # it in wall-clock (docs/performance.md).
+                from horovod_tpu.obs import perfmodel as PM
+                n_local = hier[0]
+                cost = PM.expected_hierarchical(
+                    numel * itemsize, n_local, n // n_local,
+                    itemsize=itemsize, mode=resolved or "fp32",
+                    cross_mode=cross_mode, chunks=k,
+                    block=cfg.quant_block_size)
+                row["cross_precision"] = cross_mode
+                row["local_wire_bytes"] = int(
+                    cost.tiers["local"].wire_bytes)
+                row["cross_wire_bytes"] = int(
+                    cost.tiers["cross"].wire_bytes)
+                flat_wire = R.ring_wire_bytes(
+                    "fp32", numel * itemsize, n, cfg.quant_block_size,
+                    itemsize)
+                row["cross_wire_reduction"] = round(
+                    flat_wire / cost.tiers["cross"].wire_bytes, 2) \
+                    if cost.tiers["cross"].wire_bytes else None
     if resolved != "fp32":
         block = cfg.quant_block_size
         wire = R.ring_wire_bytes(resolved, payload, n, block, itemsize)
@@ -224,6 +282,92 @@ def sweep(sizes=None, modes=("fp32",), schedules=("monolithic",),
             for sc in schedules for m in modes for s in sizes]
 
 
+def hierarchy_sweep(sizes=None, cross_modes=("fp32", "int8", "fp8"),
+                    n_local: int = 0, **kw) -> list[dict]:
+    """Flat vs tiered-kernel vs chunked+tiered rows, cross modes swept.
+
+    Three variants per size (see module docstring for the rig caveat):
+
+    - ``flat``       — monolithic single-ring baseline;
+    - ``tier:<nl>``  — the unchunked hierarchical kernel
+      (``cfg.hierarchical_allreduce`` routing, ops/hierarchical.py);
+    - ``hier:<nl>:2``— the sched executor's chunked+tiered pipeline,
+      once per cross wire mode (``cfg.hierarchical_cross_precision``).
+    """
+    import os
+    import horovod_tpu as hvd
+
+    cfg = hvd.global_state().config
+    n = hvd.size()
+    nl = (n_local
+          or int(os.environ.get("HVDTPU_HIERARCHICAL_LOCAL_SIZE", "0") or 0)
+          or cfg.hierarchical_local_size
+          or (n // 2 if n >= 4 and n % 2 == 0 else 0))
+    if not (1 < nl < n) or n % nl:
+        raise SystemExit(
+            f"--hierarchy needs a valid two-tier split of np={n} "
+            f"(got n_local={nl}); run with --cpu-devices 4 for a 2x2 rig")
+    if sizes is None:
+        sizes = [1 << p for p in range(16, 25, 2)]   # 64 KB .. 16 MB
+    rows: list[dict] = []
+    saved = (cfg.hierarchical_allreduce, cfg.hierarchical_local_size,
+             cfg.hierarchical_cross_precision)
+    import sys
+    kw.setdefault("fence_each", True)
+    # Serialize the executor's sub-program pipeline too: on a few-core
+    # host the in-process XLA:CPU rendezvous intermittently deadlocks
+    # when independent tiered sub-programs are in flight together (see
+    # executor._FENCE_DISPATCH).  Overlap gauges read 0 under the fence,
+    # which this rig could not measure honestly anyway.
+    from horovod_tpu.ops.sched import executor as SE
+    if os.environ.get("HVDTPU_SCHED_FENCE_DISPATCH", "") != "0":
+        SE._FENCE_DISPATCH = True
+    try:
+        cfg.hierarchical_local_size = nl
+        for s in sizes:
+            print(f"# hierarchy sweep: {s} bytes", file=sys.stderr,
+                  flush=True)
+            cfg.hierarchical_allreduce = False
+            cfg.hierarchical_cross_precision = ""
+            r = allreduce_busbw(s, **kw)
+            r["hierarchy"] = "flat"
+            rows.append(r)
+            print("#   flat ok", file=sys.stderr, flush=True)
+            # Tiered monolithic kernel: flag-routed, no chunking.  It
+            # bypasses the sched executor, so attach the per-tier
+            # analytics here (same accounting the hier:* rows get).
+            cfg.hierarchical_allreduce = True
+            r = allreduce_busbw(s, **kw)
+            r["hierarchy"] = f"tier:{nl}"
+            from horovod_tpu.ops import reduction as R
+            from horovod_tpu.obs import perfmodel as PM
+            cost = PM.expected_hierarchical(
+                r["bytes"], nl, n // nl, mode=r["wire_precision"] or "fp32")
+            r["local_wire_bytes"] = int(cost.tiers["local"].wire_bytes)
+            r["cross_wire_bytes"] = int(cost.tiers["cross"].wire_bytes)
+            flat_wire = R.ring_wire_bytes("fp32", r["bytes"], n,
+                                          cfg.quant_block_size, 4)
+            r["cross_wire_reduction"] = round(
+                flat_wire / cost.tiers["cross"].wire_bytes, 2)
+            rows.append(r)
+            print("#   tier-kernel ok", file=sys.stderr, flush=True)
+            # Chunked+tiered schedule, every wire mode on the cross hop.
+            cfg.hierarchical_allreduce = False
+            for cm in cross_modes:
+                cfg.hierarchical_cross_precision = (
+                    "" if cm in ("", "fp32") else cm)
+                r = allreduce_busbw(s, schedule=f"hier:{nl}:2", **kw)
+                r["hierarchy"] = f"hier:{nl}:2"
+                r.setdefault("cross_precision", cm if cm != "fp32" else "")
+                rows.append(r)
+                print(f"#   hier cross={cm} ok", file=sys.stderr,
+                      flush=True)
+    finally:
+        (cfg.hierarchical_allreduce, cfg.hierarchical_local_size,
+         cfg.hierarchical_cross_precision) = saved
+    return rows
+
+
 def main() -> None:
     import argparse
     ap = argparse.ArgumentParser()
@@ -254,6 +398,12 @@ def main() -> None:
                     help="collective to sweep; alltoall is the MoE "
                     "dispatch/combine verb and ignores wire-precision/"
                     "schedule (those are reduction machinery)")
+    ap.add_argument("--hierarchy", action="store_true",
+                    help="two-tier sweep: flat vs tiered kernel vs "
+                    "chunked+tiered (hier:<n_local>:2) with fp32/int8/fp8 "
+                    "on the cross hop; hier rows carry analytic per-tier "
+                    "wire bytes (the CPU rig cannot show the 1/n_local "
+                    "win in wall-clock — see module docstring)")
     args = ap.parse_args()
     if args.cpu_devices:
         from horovod_tpu.utils.cpurig import force_cpu_platform
@@ -266,6 +416,47 @@ def main() -> None:
     modes = [m.strip() for m in args.wire_precision.split(",") if m.strip()]
     schedules = [s.strip() for s in args.schedule.split(",") if s.strip()]
     sizes = [1 << p for p in range(12, 21, 2)] if args.quick else None
+    if args.hierarchy:
+        hsizes = sizes if args.quick else None
+        rows = hierarchy_sweep(sizes=hsizes)
+        for r in rows:
+            print(json.dumps(r))
+        # Per-variant summary at >= 1 MB: measured wall-clock ratio vs
+        # flat (expected <= 1 on the shared-memory rig) and the analytic
+        # cross_wire_reduction (the number that transfers to a real
+        # two-tier fabric).
+        base = {r["bytes"]: r for r in rows if r["hierarchy"] == "flat"}
+        summary = []
+        groups: dict = {}
+        for r in rows:
+            if r["hierarchy"] == "flat":
+                continue
+            groups.setdefault(
+                (r["hierarchy"], r.get("cross_precision", "")),
+                []).append(r)
+        for (hv, cm), grp in sorted(groups.items()):
+            big = [r for r in grp
+                   if r["bytes"] >= (1 << 20) and r["bytes"] in base]
+            if not big:
+                continue
+            ratios = [r["dispatch_GBs"] / base[r["bytes"]]["dispatch_GBs"]
+                      for r in big]
+            rec = {
+                "metric": f"allreduce_{hv}_vs_flat_at_1MB_plus",
+                "cross_precision": cm,
+                "measured_dispatch_ratio": round(float(np.mean(ratios)), 3),
+                "cross_wire_reduction": big[-1].get("cross_wire_reduction"),
+                "local_wire_bytes": big[-1].get("local_wire_bytes"),
+                "cross_wire_bytes": big[-1].get("cross_wire_bytes"),
+                "ranks": big[-1]["ranks"],
+            }
+            summary.append(rec)
+            print(json.dumps(rec))
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump({"schedule_sweep": summary, "rows": rows}, fh,
+                          indent=1)
+        return
     rows = sweep(sizes=sizes, modes=modes, schedules=schedules,
                  verb=args.verb)
     for r in rows:

@@ -108,7 +108,18 @@ def extract_bench_row(obj: dict, round_id: str, order: int,
     if not op or not isinstance(nbytes, (int, float)) or not ranks:
         return out
     wp = obj.get("wire_precision") or "fp32"
+    # Hierarchical rows (--hierarchy sweep): the cross-tier wire mode and
+    # the tiered-kernel variant are distinct series — a "tier:2" kernel
+    # row must not fold into the flat monolithic baseline, and an int8
+    # cross hop must not fold into the fp32 one.  The mixed label
+    # matches obs/perfmodel's "<mode>/<cross_mode>" convention.
+    cp = obj.get("cross_precision")
+    if cp and cp != wp:
+        wp = f"{wp}/{cp}"
     sched = obj.get("schedule") or "monolithic"
+    hier = obj.get("hierarchy")
+    if hier and hier != "flat" and sched == "monolithic":
+        sched = hier
     kind = f"cpu-rig-np{int(ranks)}"
     size = _size_label(int(nbytes))
     if "busbw_GBs" in obj:

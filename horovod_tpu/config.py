@@ -42,6 +42,21 @@ def _parse_wire_precision(v: str) -> str:
     return lv
 
 
+def _parse_cross_precision(v: str) -> str:
+    # Distinct wire mode for the hierarchical cross-tier (DCN) hop.
+    # Only the block-scaled quant modes make sense there: the cast modes
+    # (bf16/fp16) are whole-collective single-psum shapes that cannot be
+    # spliced into one hop of a tiered pipeline.
+    lv = v.strip().lower()
+    if lv in ("", "fp32"):
+        return "" if lv == "" else "fp32"
+    if lv in ("int8", "fp8"):
+        return lv
+    raise ValueError(
+        "hierarchical cross precision must be one of ''/fp32/int8/fp8 "
+        f"(cast modes cannot ride a single tier), got {v!r}")
+
+
 def _parse_sched_mode(v: str) -> str:
     lv = v.strip().lower()
     if lv not in ("monolithic", "decomposed"):
@@ -196,10 +211,17 @@ class Config:
     # On TPU: two-level = ICI within a slice + DCN across slices.
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
-    # ICI-group size for the two-level split (ranks per slice).  Default:
-    # this process's device count (= one host's chips), the analogue of the
-    # reference's "local ranks per node".
+    # ICI-group size for the two-level split (ranks per slice).  None =
+    # detect from topology: multislice slice boundaries first, else the
+    # runner's per-host rank layout (HVDTPU_LOCAL_SIZE), else this
+    # process's device count — the analogue of the reference's "local
+    # ranks per node".  Setting it is the explicit override.
     hierarchical_local_size: Optional[int] = None
+    # Wire mode for the cross-tier (DCN) hop only: ""/fp32 = same as the
+    # collective's resolved mode; int8/fp8 = block-scaled quantization on
+    # the bandwidth-starved slow tier while the fast tier stays at the
+    # base mode (EQuARX's placement).  Cast modes are rejected.
+    hierarchical_cross_precision: str = ""
 
     # --- elastic († runner/elastic) ---
     elastic: bool = False
@@ -284,6 +306,8 @@ _ENV_TABLE = [
     ("hierarchical_allreduce", "HIERARCHICAL_ALLREDUCE", _parse_bool),
     ("hierarchical_allgather", "HIERARCHICAL_ALLGATHER", _parse_bool),
     ("hierarchical_local_size", "HIERARCHICAL_LOCAL_SIZE", int),
+    ("hierarchical_cross_precision", "HIERARCHICAL_CROSS_PRECISION",
+     _parse_cross_precision),
     ("elastic", "ELASTIC", _parse_bool),
     ("autoscale", "AUTOSCALE", _parse_bool),
     ("autoscale_interval_s", "AUTOSCALE_INTERVAL_SECONDS", float),

@@ -180,31 +180,93 @@ def expected_collective(verb: str, payload_bytes: int, n: int, *,
 
 
 def expected_hierarchical(payload_bytes: int, n_local: int, n_cross: int,
-                          *, itemsize: int = 4) -> ExpectedCost:
-    """Two-tier allreduce (ops/hierarchical.py):
+                          *, itemsize: int = 4, mode: str = "fp32",
+                          cross_mode: str = "", chunks: int = 1,
+                          block: int = 512) -> ExpectedCost:
+    """Two-tier allreduce (ops/hierarchical.py, sched executor hier path):
     reduce_scatter@local -> all_reduce@cross -> all_gather@local.
 
     Per chip: the local tier carries a reduce-scatter plus an allgather
     of the full payload B (2 * (n_l-1)/n_l * B); the cross tier carries
-    a full allreduce of the local shard B/n_l (2 * (n_c-1)/n_c * B/n_l).
+    a full allreduce of the local shard B/n_l (2 * (n_c-1)/n_c * B/n_l)
+    — the 1/n_local factor is THE hierarchy win on a slow cross fabric.
+
+    Each tier rides its own wire mode (``cross_mode`` defaults to
+    ``mode``; e.g. fp32 ICI + int8 DCN) and chunking multiplies each
+    tier's latency steps without changing wire bytes, exactly like
+    :func:`expected_allreduce`.
     """
     if n_local < 1 or n_cross < 1:
         raise ValueError("tier sizes must be >= 1")
+    mode = mode or "fp32"
+    cmode = cross_mode or mode
+    k = max(1, int(chunks))
     B = float(payload_bytes)
+    numel = B / max(1, itemsize)
     fl = (n_local - 1) / n_local if n_local > 1 else 0.0
     fc = (n_cross - 1) / n_cross if n_cross > 1 else 0.0
-    local = TierCost(wire_bytes=2.0 * fl * B,
-                     steps=2 * (n_local - 1) if n_local > 1 else 0)
-    cross = TierCost(wire_bytes=2.0 * fc * (B / n_local),
-                     steps=2 * (n_cross - 1) if n_cross > 1 else 0)
+    wl = wire_per_elem(mode, itemsize, block) / (2.0 * itemsize)
+    wc = wire_per_elem(cmode, itemsize, block) / (2.0 * itemsize)
+    local = TierCost(wire_bytes=2.0 * fl * B * wl,
+                     steps=2 * (n_local - 1) * k if n_local > 1 else 0)
+    cross = TierCost(wire_bytes=2.0 * fc * (B / n_local) * wc,
+                     steps=2 * (n_cross - 1) * k if n_cross > 1 else 0)
     n = n_local * n_cross
+    sched = "hier" if k == 1 else f"hier:{n_local}:{k}"
+    label = mode if cmode == mode else f"{mode}/{cmode}"
     return ExpectedCost(
-        verb="allreduce", mode="fp32", schedule="hier", n=n,
+        verb="allreduce", mode=label, schedule=sched, n=n,
         payload_bytes=payload_bytes,
         wire_bytes=local.wire_bytes + cross.wire_bytes,
         steps=local.steps + cross.steps,
         busbw_factor=busbw_factor("allreduce", n),
         tiers={"local": local, "cross": cross})
+
+
+def hier_split_table(payload_sizes, n: int, n_local: int, *,
+                     mode: str = "fp32", cross_mode: str = "",
+                     chunks: int = 1, block: int = 512, itemsize: int = 4,
+                     gbs_local: float, gbs_cross: float,
+                     latency_us: float = 1.0,
+                     phase_overhead_us: float = 20.0) -> list:
+    """Per-message-size flat-vs-hierarchical decision table (HiCCL's
+    level-split selection, scored by this model's per-tier costs).
+
+    A flat ring over a two-tier fabric is bottlenecked by its slowest
+    hop — every ring step crosses the slow fabric at least once per
+    round — so flat is scored at ``gbs_cross``; the hierarchical
+    schedule pays the full local volume at ``gbs_local`` plus only the
+    1/n_local shard at ``gbs_cross``.  Small messages go flat:
+    ``phase_overhead_us`` charges the host-side dispatch of each
+    pipeline phase (flat rides one fused program per chunk; the tiered
+    path dispatches three per chunk), which dominates until the wire
+    term takes over.  Returns one row per size: ``{payload_bytes,
+    flat_seconds, hier_seconds, split}`` with ``split`` in
+    ``("flat", "hier")``.
+    """
+    if n_local < 2 or n % n_local:
+        raise ValueError(f"n_local={n_local} does not tier n={n}")
+    n_cross = n // n_local
+    k = max(1, int(chunks))
+    rows = []
+    for B in payload_sizes:
+        flat = expected_allreduce(B, n, mode=mode, chunks=chunks,
+                                  block=block, itemsize=itemsize)
+        flat_s = (flat.expected_seconds(gbs_cross, latency_us)
+                  + k * phase_overhead_us * 1e-6)
+        hier = expected_hierarchical(
+            B, n_local, n_cross, itemsize=itemsize, mode=mode,
+            cross_mode=cross_mode, chunks=chunks, block=block)
+        hier_s = 3 * k * phase_overhead_us * 1e-6
+        for name, gbs in (("local", gbs_local), ("cross", gbs_cross)):
+            tc = hier.tiers[name]
+            hier_s += (tc.steps * latency_us * 1e-6
+                       + tc.wire_bytes / (max(1e-9, gbs) * 1e9))
+        rows.append({"payload_bytes": int(B),
+                     "flat_seconds": flat_s,
+                     "hier_seconds": hier_s,
+                     "split": "hier" if hier_s < flat_s else "flat"})
+    return rows
 
 
 class PerfModel:
@@ -338,7 +400,10 @@ class PerfModel:
 
     def observe_tiers(self, payload_bytes: int, n_local: int,
                       n_cross: int, seconds: float, *,
-                      tier_seconds: Optional[dict] = None) -> dict:
+                      tier_seconds: Optional[dict] = None,
+                      mode: str = "fp32", cross_mode: str = "",
+                      chunks: int = 1, schedule: str = "",
+                      block: int = 512, itemsize: int = 4) -> dict:
         """Two-tier attribution (ROADMAP item 3's straggler feed).
 
         With measured per-tier times, excess = achieved - expected per
@@ -347,7 +412,11 @@ class PerfModel:
         points at the tier that dominates the bound, which is the
         decision the ICI/DCN lowering needs.
         """
-        cost = expected_hierarchical(payload_bytes, n_local, n_cross)
+        cost = expected_hierarchical(
+            payload_bytes, n_local, n_cross, itemsize=itemsize,
+            mode=mode, cross_mode=cross_mode, chunks=chunks, block=block)
+        if schedule:
+            cost = dataclasses.replace(cost, schedule=schedule)
         total_wire = max(1e-12, cost.wire_bytes)
         out = {}
         with self._lock:

@@ -376,9 +376,48 @@ def _build_reducescatter(mesh: Mesh, axis: str, op: ReduceOp):
     return jax.jit(fn)
 
 
+def _detect_local_size(state) -> Optional[int]:
+    """Fast-tier (ICI) group size from topology, not from a knob.
+
+    Preference order:
+
+    1. **Slice boundaries** — on a multislice TPU pod every jax device
+       carries a ``slice_index``; uniform per-slice device counts over
+       more than one slice ARE the ICI/DCN split (intra-slice links are
+       ICI, inter-slice is DCN).
+    2. **Per-host rank layout** — the runner exports
+       ``HVDTPU_LOCAL_SIZE`` per worker; ranks on one host share a host
+       interconnect that beats the network between hosts.
+    3. **This process's device count** — the single-controller analogue
+       of "local ranks per node" (the historical default).
+    """
+    devices = list(getattr(state, "devices", ()) or ())
+    slices: dict = {}
+    for d in devices:
+        si = getattr(d, "slice_index", None)
+        if si is None:
+            slices = {}
+            break
+        slices[si] = slices.get(si, 0) + 1
+    if len(slices) > 1:
+        counts = set(slices.values())
+        if len(counts) == 1:
+            return counts.pop()
+    cfg = state.config
+    if cfg.local_size_env:
+        return int(cfg.local_size_env)
+    return getattr(state, "local_size", None)
+
+
 def _hier_split(process_set) -> Optional[tuple[int, int]]:
     """(n_cross, n_local) when two-level allreduce is enabled and valid
-    († HOROVOD_HIERARCHICAL_ALLREDUCE gate in nccl_operations.cc)."""
+    († HOROVOD_HIERARCHICAL_ALLREDUCE gate in nccl_operations.cc).
+
+    ``hierarchical_local_size`` is the explicit override; otherwise the
+    split comes from :func:`_detect_local_size` (slice boundaries, then
+    the runner's per-host layout).  Invalid splits (indivisible world,
+    one-rank or whole-world "tier") fall back to the flat path — same on
+    every rank, since the inputs are synchronized config + topology."""
     if process_set is not None:
         return None  # subgroup topology unknown; flat path
     state = ctx_mod.global_state()
@@ -386,8 +425,8 @@ def _hier_split(process_set) -> Optional[tuple[int, int]]:
     if not cfg.hierarchical_allreduce:
         return None
     n = state.size
-    n_local = cfg.hierarchical_local_size or state.local_size
-    if n_local <= 1 or n_local >= n or n % n_local:
+    n_local = cfg.hierarchical_local_size or _detect_local_size(state)
+    if not n_local or n_local <= 1 or n_local >= n or n % n_local:
         return None
     return (n // n_local, n_local)
 

@@ -189,10 +189,11 @@ def _parse_joinable_meta(meta: str) -> Optional[dict]:
             # build a matching program — skip, don't crash the cycle.
             return None
         if m.get("sc", ""):
-            from .sched import parse_descriptor
-            if parse_descriptor(m["sc"]) is None:
-                # Unknown schedule lowering from a version-skewed peer:
-                # same rule — skip, don't crash the cycle.
+            from .sched import known_descriptor
+            if not known_descriptor(m["sc"]):
+                # Unknown schedule lowering from a version-skewed peer
+                # (neither rs_ag:<k> nor hier:<n_local>:<k>): same rule
+                # — skip, don't crash the cycle.
                 return None
     except (ValueError, TypeError, KeyError):
         return None

@@ -30,9 +30,13 @@ from .lower import (  # noqa: F401
     SCHED_MODES,
     chunk_layout,
     descriptor,
+    hier_descriptor,
+    known_descriptor,
     lower_allreduce,
     lower_hierarchical,
+    lower_hierarchical_chunked,
     parse_descriptor,
+    parse_hier_descriptor,
 )
 from .in_context import (  # noqa: F401
     matmul_reducescatter,
@@ -50,17 +54,28 @@ def resolve_schedule(requested: str, verb: str, op: Any, dtype: Any,
 
     ``requested`` is the per-call override: ``""`` defers to
     ``cfg.sched_mode``; ``"monolithic"``/``"decomposed"`` name the mode;
-    a concrete ``"rs_ag:<k>"`` descriptor passes through.  Returns
-    ``""`` (monolithic) or a concrete descriptor.  Falls back to
-    monolithic whenever decomposition cannot apply: non-allreduce verbs,
-    non-sum reductions, non-float payloads, single-rank meshes, payloads
-    too small to cut into >= 2 chunks, hierarchical mode (the two-tier
-    path owns its own schedule — see ``ops/hierarchical.py``), and the
-    bf16/fp16 **cast** wire modes — their monolithic form casts once and
-    rides a single psum whose ring is already 2-byte end to end, so a
-    decomposed variant would either re-round the combined shard onto the
-    cast grid a second time (diverging from the monolithic result) or
-    gather at 4 bytes (forfeiting the wire saving it is credited for).
+    a concrete ``"rs_ag:<k>"`` or ``"hier:<n_local>:<k>"`` descriptor
+    passes through.  Returns ``""`` (monolithic) or a concrete
+    descriptor.  Falls back to monolithic whenever decomposition cannot
+    apply: non-allreduce verbs, non-sum reductions, non-float payloads,
+    single-rank meshes, payloads too small to cut into >= 2 chunks, and
+    the bf16/fp16 **cast** wire modes — their monolithic form casts once
+    and rides a single psum whose ring is already 2-byte end to end, so
+    a decomposed variant would either re-round the combined shard onto
+    the cast grid a second time (diverging from the monolithic result)
+    or gather at 4 bytes (forfeiting the wire saving it is credited
+    for).
+
+    Hierarchical mode (``cfg.hierarchical_allreduce``) composes rather
+    than suppresses: a decomposed request under a valid topology split
+    (see :func:`ops.collectives._hier_split` — env override, else
+    slice/host detection) upgrades to the chunked+tiered
+    ``hier:<n_local>:<k>`` family, so chunk *i*'s cross-tier hop
+    overlaps chunk *i+1*'s local scatter.  A monolithic request under
+    the flag keeps returning ``""`` — the unchunked two-level kernel in
+    ``ops/hierarchical.py``/``ops/collectives.py`` owns that path.  An
+    invalid split (indivisible world, single host) falls back to the
+    flat descriptor, same as before.
     """
     import jax.numpy as jnp
     from ..collectives import ReduceOp
@@ -68,6 +83,7 @@ def resolve_schedule(requested: str, verb: str, op: Any, dtype: Any,
 
     req = requested or getattr(cfg, "sched_mode", "monolithic") \
         or "monolithic"
+    hier_req = None     # explicit hier:<n_local>:<k> request
     if req == "monolithic":
         return ""
     if req == "decomposed":
@@ -75,9 +91,13 @@ def resolve_schedule(requested: str, verb: str, op: Any, dtype: Any,
     else:
         k = parse_descriptor(req)
         if k is None:
-            raise ValueError(
-                f"unknown schedule {req!r}; expected 'monolithic', "
-                "'decomposed' or 'rs_ag:<chunks>'")
+            hier_req = parse_hier_descriptor(req)
+            if hier_req is None:
+                raise ValueError(
+                    f"unknown schedule {req!r}; expected 'monolithic', "
+                    "'decomposed', 'rs_ag:<chunks>' or "
+                    "'hier:<n_local>:<chunks>'")
+            k = hier_req[1]
     if verb != "allreduce" or n <= 1 or k < 2:
         return ""
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
@@ -88,16 +108,34 @@ def resolve_schedule(requested: str, verb: str, op: Any, dtype: Any,
         itemsize = jnp.dtype(dtype).itemsize
     except TypeError:
         return ""
-    if getattr(cfg, "hierarchical_allreduce", False):
-        return ""
     if mode in ("bf16", "fp16"):
         return ""   # cast wire keeps the single-psum shape (docstring)
+    # Tier split: explicit hier request, or the hierarchical flag
+    # upgrading a decomposed request.  Both validate against the mesh;
+    # an unusable split degrades to the flat descriptor (hier request)
+    # or plain rs_ag (flag), deterministically on every rank.
+    n_local = 0
+    if hier_req is not None:
+        n_local = hier_req[0]
+        if n % n_local or not (1 < n_local < n):
+            n_local = 0
+    elif getattr(cfg, "hierarchical_allreduce", False):
+        from ..collectives import _hier_split
+        split = _hier_split(None)
+        if split is not None:
+            n_local = split[1]
+    cross = getattr(cfg, "hierarchical_cross_precision", "") \
+        if n_local else ""
     # Size gate: need at least 2 schedulable units or there is nothing
     # to overlap (one unit per rank-group for fp32, one block-aligned
-    # rank-group for quantized modes).
+    # rank-group for quantized modes — including a quantized cross-tier
+    # hop under an fp32 fast tier, whose shards must land on block
+    # boundaries too).
     unit = (n * getattr(cfg, "quant_block_size", 512)
-            if mode in R.QUANT_MODES else n)
+            if (mode in R.QUANT_MODES or cross in R.QUANT_MODES) else n)
     numel = max(1, nbytes // max(1, itemsize))
     if numel < 2 * unit:
         return ""
+    if n_local:
+        return hier_descriptor(n_local, k)
     return descriptor(k)

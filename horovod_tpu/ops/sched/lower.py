@@ -17,11 +17,13 @@ from typing import Optional
 
 from .ir import Schedule, _Builder
 
-#: Descriptor grammar for negotiation metas: the only schedule family the
-#: engine currently lowers is the chunked reduce-scatter/allgather
-#: decomposition.  Unknown descriptors from version-skewed peers must be
-#: rejected (parse -> None), never guessed at.
+#: Descriptor grammar for negotiation metas: two schedule families ride
+#: the ``sc`` field — the chunked reduce-scatter/allgather decomposition
+#: (``rs_ag:<k>``) and the chunked+tiered two-level allreduce
+#: (``hier:<n_local>:<k>``).  Unknown descriptors from version-skewed
+#: peers must be rejected (parse -> None), never guessed at.
 _DESC_RE = re.compile(r"^rs_ag:(\d+)$")
+_HIER_DESC_RE = re.compile(r"^hier:(\d+):(\d+)$")
 
 #: Schedule-mode config values (``HOROVOD_TPU_SCHED_MODE``).
 SCHED_MODES = ("monolithic", "decomposed")
@@ -44,6 +46,35 @@ def parse_descriptor(desc: str) -> Optional[int]:
 
 def descriptor(chunks: int) -> str:
     return f"rs_ag:{int(chunks)}"
+
+
+def parse_hier_descriptor(desc: str) -> Optional[tuple]:
+    """``"hier:<n_local>:<k>"`` -> ``(n_local, chunks)``, or None.
+
+    The tiered sibling of :func:`parse_descriptor`: ``n_local`` is the
+    fast-tier (ICI) group size every rank agreed on, ``k`` the chunk
+    count.  ``n_local >= 2`` is required — a one-rank "tier" is just the
+    flat schedule and must never be encoded as hier (two ranks lowering
+    differently for the same meta would desynchronize dispatch).
+    """
+    m = _HIER_DESC_RE.match(desc or "")
+    if not m:
+        return None
+    n_local, k = int(m.group(1)), int(m.group(2))
+    if n_local < 2 or k < 1:
+        return None
+    return (n_local, k)
+
+
+def hier_descriptor(n_local: int, chunks: int) -> str:
+    return f"hier:{int(n_local)}:{int(chunks)}"
+
+
+def known_descriptor(desc: str) -> bool:
+    """True when ``desc`` belongs to a schedule family this build can
+    lower — the negotiation meta's validity check for the ``sc`` field."""
+    return (parse_descriptor(desc) is not None or
+            parse_hier_descriptor(desc) is not None)
 
 
 def chunk_layout(numel: int, n: int, chunks: int, mode: str,
@@ -149,3 +180,67 @@ def lower_hierarchical(local_axis: str, cross_axis: str) -> Schedule:
     b.add("all_gather", chunk=0, axis=local_axis, deps=[cb])
     return b.build("hier", chunks=1, mode="fp32",
                    descriptor=f"hier:{local_axis}/{cross_axis}")
+
+
+def lower_hierarchical_chunked(
+        numel: int, n_local: int, n_cross: int, *, op_average: bool,
+        mode: str, cross_mode: str, chunks: int, local_axis: str,
+        cross_axis: str, block: int = 512) -> Schedule:
+    """Chunked + tiered allreduce: ``rs_ag:k`` chunking composed with the
+    two-tier split so chunk *i*'s slow-tier (DCN) allreduce overlaps
+    chunk *i+1*'s fast-tier (ICI) reduce-scatter.
+
+    Per chunk *c* the pipeline is::
+
+        [encode(c)] -> reduce_scatter(c)@local -> all_reduce(c)@cross
+                    -> combine(c) -> all_gather(c)@local -> [decode(c)]
+
+    The cross-tier ``all_reduce`` moves only the 1/n_local shard and
+    carries its own wire mode (``cross_mode`` — e.g. int8 on DCN under
+    fp32 ICI, per EQuARX); ``combine`` is the post-cross dequant/average/
+    requant.  :meth:`~.ir.Schedule.interleaved_order` ranks all local
+    scatters ahead of every post-scatter step, so the dispatch order is
+    ``RS(c0), RS(c1), ..., AR(c0), CB(c0), AG(c0), AR(c1), ...`` — chunk
+    c's cross hop runs under chunk c+1's local scatter.
+
+    Chunk boundaries reuse :func:`chunk_layout` with ``n = n_local *
+    n_cross`` (total ranks): the quantized unit ``n * block`` makes each
+    chunk's 1/n_local local shard a whole number of ``n_cross * block``
+    units (so the cross hop can itself scatter on block boundaries), and
+    — deliberately — lands on the SAME boundaries the flat lowering
+    uses, so quantized hier results are bit-identical to flat per chunk.
+    """
+    if n_local < 2 or n_cross < 2:
+        raise ValueError(f"bad tier split ({n_local}, {n_cross})")
+    b = _Builder()
+    n = n_local * n_cross
+    from ..reduction import QUANT_MODES
+    mode_eff = mode if mode in QUANT_MODES else (
+        cross_mode if cross_mode in QUANT_MODES else mode)
+    layout = chunk_layout(numel, n, chunks, mode_eff, block)
+    k = len(layout)
+    quant = mode in QUANT_MODES
+    cross_quant = cross_mode in QUANT_MODES
+    split = b.add("chunk")
+    tails = []
+    for c in range(k):
+        prev = split
+        if quant:
+            prev = b.add("encode", chunk=c, mode=mode, deps=[prev])
+        rs = b.add("reduce_scatter", chunk=c, axis=local_axis, deps=[prev])
+        ar = b.add("all_reduce", chunk=c, axis=cross_axis,
+                   mode=cross_mode if cross_quant else "", deps=[rs])
+        prev = ar
+        if quant or cross_quant or op_average:
+            prev = b.add("combine", chunk=c,
+                         mode=mode if quant else
+                         (cross_mode if cross_quant else ""),
+                         deps=[prev])
+        ag = b.add("all_gather", chunk=c, axis=local_axis, deps=[prev])
+        prev = ag
+        if quant:
+            prev = b.add("decode", chunk=c, mode=mode, deps=[prev])
+        tails.append(prev)
+    b.add("concat", deps=tails)
+    return b.build("hier", chunks=k, mode=mode,
+                   descriptor=hier_descriptor(n_local, chunks))

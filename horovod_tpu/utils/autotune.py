@@ -12,24 +12,30 @@ kernel + expected improvement over a candidate grid).  Eigen/LBFGS hyperparam
 refits are replaced by a small fixed-length-scale kernel — adequate for a
 low-noise search space.
 
-Knob space, v3: 4-D.  Beyond the reference's (threshold, cycle-time),
+Knob space, v4: 5-D.  Beyond the reference's (threshold, cycle-time),
 the third dimension is the engine's **wire precision**
 (``ops/reduction.py``): fp32, bf16, or block-scaled int8; the fourth is
 the **collective schedule** (``ops/sched``): monolithic vs the
-decomposed reduce-scatter/allgather pipeline at a candidate chunk count.
+decomposed reduce-scatter/allgather pipeline at a candidate chunk count;
+the fifth is the **hierarchy split** (``ops/hierarchical`` + the sched
+executor's ``hier:<n_local>:<k>`` path): flat, the topology-detected
+two-tier split, or the detected split halved — HiCCL's level-split
+selection as a search dimension, seeded by the perfmodel's analytic
+per-message-size decision table (logged at init).
 The score is *effective* bytes/s — logical fp32 payload bytes per cycle
 second — so a mode that moves fewer wire bytes (or overlaps more of its
 communication) in less time scores higher, and the GP picks what the
-interconnect actually rewards (on TPU, quantized + decomposed; on the
-CPU rig, whose collectives are byte-width-insensitive and serialized, it
-correctly learns fp32 + monolithic).
+interconnect actually rewards (on TPU, quantized + decomposed + tiered;
+on the CPU rig, whose collectives are byte-width-insensitive and
+serialized, it correctly learns fp32 + monolithic + flat).
 
-Multi-process jobs pin the precision AND schedule dimensions to the
-configured defaults: each rank scores from rank-local timings, and a
-per-rank commit of either would resolve the same tensor to different
-wire modes / chunk programs on different ranks at enqueue — divergent
-fused XLA dispatches across processes, i.e. a hang.  Single-controller
-mode (one process, all devices) tunes all four dimensions.
+Multi-process jobs pin the precision, schedule AND hierarchy dimensions
+to the configured defaults: each rank scores from rank-local timings,
+and a per-rank commit of any of them would resolve the same tensor to
+different wire modes / chunk programs / tier meshes on different ranks
+at enqueue — divergent fused XLA dispatches across processes, i.e. a
+hang.  Single-controller mode (one process, all devices) tunes all five
+dimensions.
 
 Tensor-size bucketing: the precision knob governs the *quantizable
 bucket* — tensors at or above ``quant_min_bytes``.  Tensors below the
@@ -76,7 +82,7 @@ _m_cycle_ms = _obs.gauge(
 
 
 class _GP:
-    """Minimal RBF-kernel GP regressor for the 4-D knob space."""
+    """Minimal RBF-kernel GP regressor for the 5-D knob space."""
 
     def __init__(self, length_scale: float = 1.0, noise: float = 1e-3) -> None:
         self.ls = length_scale
@@ -115,12 +121,14 @@ class Autotuner:
     """Propose/score loop attached to the engine's cycle callback."""
 
     def _norm_point(self, threshold: int, cycle_ms: float, mode: str,
-                    sched: str) -> tuple[float, float, float, float]:
-        """Raw knobs -> GP coordinates (mode/sched indices are
+                    sched: str, hier: str
+                    ) -> tuple[float, float, float, float, float]:
+        """Raw knobs -> GP coordinates (mode/sched/hier indices are
         instance-local)."""
         return (math.log2(threshold), math.log2(cycle_ms),
                 self._modes.index(mode) * _MODE_SCALE,
-                self._scheds.index(sched) * _MODE_SCALE)
+                self._scheds.index(sched) * _MODE_SCALE,
+                self._hiers.index(hier) * _MODE_SCALE)
 
     def __init__(self, state) -> None:
         self._state = state
@@ -151,30 +159,76 @@ class Autotuner:
                          if getattr(cfg, "sched_mode", "monolithic")
                          != "decomposed"
                          else f"rs_ag:{max(1, cfg.sched_chunks)}")
+        # Hierarchy dimension (HiCCL level split): "flat" plus the
+        # topology-detected two-tier split and the detected split halved
+        # ("tier:<n_local>"), when they actually tier this world size.
+        n = getattr(state, "size", 1)
+        detected = None
+        try:
+            from ..ops.collectives import _detect_local_size
+            nl = _detect_local_size(state)
+            if nl and 1 < nl < n and n % nl == 0:
+                detected = int(nl)
+        except Exception:
+            detected = None
+        hier_vals = ["flat"]
+        if detected:
+            hier_vals.append(f"tier:{detected}")
+            half = detected // 2
+            if 2 <= half < n and n % half == 0:
+                hier_vals.append(f"tier:{half}")
+        hier_default = "flat"
+        if getattr(cfg, "hierarchical_allreduce", False):
+            nl0 = cfg.hierarchical_local_size or detected
+            if nl0 and 1 < nl0 < n and n % nl0 == 0:
+                hier_default = f"tier:{int(nl0)}"
         if distributed:
             self._modes = [default]
             self._scheds = [sched_default]
+            self._hiers = [hier_default]
         else:
             self._modes = _WIRE_MODES + (
                 [default] if default not in _WIRE_MODES else [])
             self._scheds = _SCHED_MODES + (
                 [sched_default] if sched_default not in _SCHED_MODES
                 else [])
-        self._grid_raw = [(t, c, m, s) for t in _THRESHOLDS
+            self._hiers = hier_vals + (
+                [hier_default] if hier_default not in hier_vals else [])
+        self._grid_raw = [(t, c, m, s, h) for t in _THRESHOLDS
                           for c in _CYCLE_TIMES for m in self._modes
-                          for s in self._scheds]
+                          for s in self._scheds for h in self._hiers]
         self._grid = np.array([self._norm_point(*p) for p in self._grid_raw])
+        # Seed the hierarchy dimension with the perfmodel's analytic
+        # per-message-size split table (logged, and kept on the instance
+        # for the obs plane): which sizes should tier, before a single
+        # trial runs.
+        self.split_table: list = []
+        if detected and len(self._hiers) > 1:
+            try:
+                from ..obs.perfmodel import hier_split_table
+                gbs_cross = cfg.perf_link_gbs or 1.0
+                self.split_table = hier_split_table(
+                    _THRESHOLDS, n, detected,
+                    gbs_local=gbs_cross * 10.0,  # nominal ICI ~10x DCN
+                    gbs_cross=gbs_cross,
+                    latency_us=cfg.perf_link_latency_us)
+                self._log("hier split table (n_local=%d): %s" % (
+                    detected, ", ".join(
+                        f"{r['payload_bytes']}B->{r['split']}"
+                        for r in self.split_table)))
+            except Exception:
+                self.split_table = []
         # Normalized GP inputs AND the exact raw grid knobs of each
         # sample.  Committing from the raw record (not a ``2 ** log2``
         # round-trip of the normalized floats) keeps the committed
         # cycle-time exactly on the candidate grid — the round-trip
         # drifted (e.g. 2.5 ms -> 2.4999999999999996) so the converged
         # knobs were values no candidate ever proposed.
-        self._samples_X: list[tuple[float, float, float, float]] = []
-        self._samples_raw: list[tuple[int, float, str, str]] = []
+        self._samples_X: list[tuple[float, float, float, float, float]] = []
+        self._samples_raw: list[tuple[int, float, str, str, str]] = []
         self._samples_y: list[float] = []
         self._current = (cfg.fusion_threshold, cfg.cycle_time_ms, default,
-                         sched_default)
+                         sched_default, hier_default)
         self._acc_bytes = 0
         self._acc_time = 0.0
         self._acc_cycles = 0
@@ -197,9 +251,9 @@ class Autotuner:
             self._warmup_left -= 1
             self._log(f"warmup score={score:.3e}")
             return
-        t, c, m, s = self._current
-        self._samples_X.append(self._norm_point(t, c, m, s))
-        self._samples_raw.append((t, c, m, s))
+        t, c, m, s, h = self._current
+        self._samples_X.append(self._norm_point(t, c, m, s, h))
+        self._samples_raw.append((t, c, m, s, h))
         self._samples_y.append(score)
         _m_trials.inc()
         _m_score.set(score)
@@ -214,31 +268,32 @@ class Autotuner:
         mu, var = gp.predict(self._grid)
         ei = _expected_improvement(mu, var, y_norm.max())
         idx = int(np.argmax(ei))
-        threshold, cycle, mode, sched = self._grid_raw[idx]
-        self._apply(threshold, cycle, mode, sched)
+        threshold, cycle, mode, sched, hier = self._grid_raw[idx]
+        self._apply(threshold, cycle, mode, sched, hier)
         best = int(np.argmax(y))
         self._log(
             f"sample #{len(y)} score={y[-1]:.3e} -> next "
             f"threshold={threshold} cycle_ms={cycle} wire={mode} "
-            f"sched={sched} (best so far {self._raw(best)} @ {y[best]:.3e})")
+            f"sched={sched} hier={hier} "
+            f"(best so far {self._raw(best)} @ {y[best]:.3e})")
         # Convergence: stop after exploring enough with no improvement,
         # committing the best-seen knobs († ParameterManager stops tuning).
         if len(y) >= 12 and best < len(y) - 6:
-            bt, bc, bm, bs = self._raw(best)
-            self._apply(bt, bc, bm, bs)
+            bt, bc, bm, bs, bh = self._raw(best)
+            self._apply(bt, bc, bm, bs, bh)
             self._done = True
             self._log(f"converged: threshold={bt} cycle_ms={bc} "
-                      f"wire={bm} sched={bs}")
+                      f"wire={bm} sched={bs} hier={bh}")
 
-    def _raw(self, i: int) -> tuple[int, float, str, str]:
+    def _raw(self, i: int) -> tuple[int, float, str, str, str]:
         """Exact grid knobs of sample *i* — from the raw record, never a
         ``2 ** log2(x)`` round-trip of the normalized GP coordinates."""
         return self._samples_raw[i]
 
     def _apply(self, threshold: int, cycle_ms: float, mode: str,
-               sched: str) -> None:
+               sched: str, hier: str) -> None:
         from ..ops.sched import parse_descriptor
-        self._current = (threshold, cycle_ms, mode, sched)
+        self._current = (threshold, cycle_ms, mode, sched, hier)
         self._state.config.fusion_threshold = threshold
         self._state.config.cycle_time_ms = cycle_ms
         self._state.config.wire_precision = mode
@@ -247,6 +302,12 @@ class Autotuner:
         else:
             self._state.config.sched_mode = "decomposed"
             self._state.config.sched_chunks = parse_descriptor(sched)
+        if hier == "flat":
+            self._state.config.hierarchical_allreduce = False
+        else:
+            self._state.config.hierarchical_allreduce = True
+            self._state.config.hierarchical_local_size = int(
+                hier.split(":", 1)[1])
         _m_threshold.set(threshold)
         _m_cycle_ms.set(cycle_ms)
         from ..ops import reduction as _R

@@ -23,6 +23,26 @@ Also exercises the negotiation meta's ``sc`` field two ways:
   issuing decomposed allreduces — the joined rank must rebuild the
   identical chunked program from the echoed meta (schedule + precision)
   or the per-chunk dispatches deadlock.
+
+``HVDTPU_TEST_MODE=hier`` (np=4, ``HVDTPU_HIERARCHICAL_LOCAL_SIZE=2``)
+runs the chunked+tiered battery instead: the ``hier:2:2`` descriptor
+negotiates over the same transport (a dispatch-counter guard proves the
+tiered executor really ran — a silent flat fallback would make parity
+vacuous) with the per-family contract:
+
+- **int8: BIT-exact vs flat** (exact int16 block sums are
+  order-independent, and tier boundaries land on the same block grid);
+- **fp8: bounded, NOT bit-exact** — fp8 accumulates in fp16
+  (ops/reduction.py), so flat/tiered agreement only ever came from a
+  shared ring order, which tiering changes; the contract is error vs
+  the true mean within 2x flat fp8's own quantization error;
+- **fp32: normwise <= 2 ulp** (re-associated sum);
+- fp32 fast tier + ``HVDTPU_HIERARCHICAL_CROSS_PRECISION=int8`` slow
+  hop: bounded vs truth;
+
+plus mixed flat+tiered fusion groups in one cycle, the join/rebuild
+path with a tiered ``sc`` descriptor, and rank-labeled
+``hvd_perf_tier_*`` gauges on the aggregated ``/cluster`` view.
 """
 
 import os
@@ -120,5 +140,138 @@ def main() -> int:
     return 0
 
 
+def main_hier() -> int:
+    import time
+
+    from horovod_tpu.obs import aggregate
+    from horovod_tpu.ops.sched.executor import _m_sched_child
+
+    hvd.init()
+    me, n = hvd.rank(), hvd.size()
+    cfg = hvd.global_state().config
+    cfg.quant_min_bytes = 0
+    assert cfg.hierarchical_local_size == 2, \
+        "launcher must set HVDTPU_HIERARCHICAL_LOCAL_SIZE=2"
+    desc = f"hier:{cfg.hierarchical_local_size}:2"
+    entry = max(2048, 2 * n * cfg.quant_block_size)
+    numel = 4 * entry
+    grads = [np.random.RandomState(300 + r).randn(numel).astype(np.float32)
+             for r in range(n)]
+    truth = np.stack(grads).mean(0)
+    eps = np.finfo(np.float32).eps
+
+    def run(mode, tag):
+        hs = [hvd.allreduce_async(
+            hvd.from_local(grads[me][None, i * entry:(i + 1) * entry]),
+            hvd.Average, name=f"h.{tag}.{i}", compression=mode or None)
+            for i in range(4)]
+        return np.concatenate(
+            [hvd.to_numpy(hvd.synchronize(h)) for h in hs])
+
+    for mode in ("", "int8", "fp8"):
+        cfg.hierarchical_allreduce = False
+        cfg.sched_mode = "monolithic"
+        ref = run(mode, f"mono.{mode or 'fp32'}")
+        cfg.hierarchical_allreduce = True
+        cfg.sched_mode, cfg.sched_chunks = "decomposed", 2
+        before = _m_sched_child(desc).value
+        got = run(mode, f"tier.{mode or 'fp32'}")
+        assert _m_sched_child(desc).value > before, (
+            f"{mode or 'fp32'}: tiered pass never dispatched {desc} "
+            "(flat fallback?) — parity would be vacuous")
+        if mode == "int8":
+            assert np.array_equal(ref, got), (
+                "int8", np.abs(ref - got).max())
+            tag = "bit-exact"
+        elif mode == "fp8":
+            flat_err = np.abs(ref - truth).max()
+            hier_err = np.abs(got - truth).max()
+            assert flat_err > 0 and hier_err <= 2 * flat_err, (
+                hier_err, flat_err)
+            tag = f"bounded err={hier_err:.1e} (flat {flat_err:.1e})"
+        else:
+            rel = np.abs(ref - got).max() / max(1e-30, np.abs(ref).max())
+            assert rel <= 2 * eps, rel
+            tag = f"ulp-bounded rel={rel:.1e}"
+        print(f"rank {me}: {mode or 'fp32'} tiered {tag}", flush=True)
+
+    # fp32 fast tier + quantized DCN hop: the cross precision rides
+    # synchronized config (not the descriptor), so every rank resolves
+    # the same mixed-mode program.
+    cfg.hierarchical_cross_precision = "int8"
+    before = _m_sched_child(desc).value
+    got = run("", "xprec")
+    assert _m_sched_child(desc).value > before
+    err = np.abs(got - truth).max()
+    assert 0 < err < 0.1, err
+    cfg.hierarchical_cross_precision = ""
+    print(f"rank {me}: cross-precision bounded err={err:.1e}", flush=True)
+
+    # Mixed tiered + flat-decomposed + monolithic entries in one cycle:
+    # the schedule joins the fusion key, so the three families must
+    # split into consistent groups on every rank (divergence hangs).
+    cfg.sched_mode = "decomposed"
+    ha = hvd.allreduce_async(hvd.from_local(grads[me][None, :4096]),
+                             hvd.Average, name="h.mix.tier")
+    cfg.hierarchical_allreduce = False
+    hb = hvd.allreduce_async(hvd.from_local(grads[me][None, :4096]),
+                             hvd.Average, name="h.mix.flat")
+    cfg.sched_mode = "monolithic"
+    hc = hvd.allreduce_async(hvd.from_local(grads[me][None, :64]),
+                             hvd.Average, name="h.mix.mono")
+    for h in (ha, hb, hc):
+        hvd.synchronize(h)
+
+    # Every rank's tiered observations must reach the aggregated cluster
+    # view rank-labeled (the CI hierarchical-parity job's obs half).
+    # This phase runs BEFORE the join phase: rank 0 must scrape while
+    # its peers are still alive (shutdown retracts their KV snapshots),
+    # and the post-poll barrier is only safe while every rank's
+    # auto-name counter still agrees (join leaves them uneven).
+    assert aggregate.publish_now(), "publisher not armed or KV unreachable"
+    if me == 0:
+        deadline = time.monotonic() + 30.0
+        while True:
+            snap = hvd.cluster_metrics()
+            fam = next((f for f in snap
+                        if f["name"] == "hvd_perf_tier_excess_seconds"),
+                       None)
+            ranks = {s["labels"].get("rank", "") for s in fam["samples"]} \
+                if fam else set()
+            if {str(r) for r in range(n)} <= ranks:
+                break
+            assert time.monotonic() < deadline, \
+                f"tier gauges never aggregated (saw {ranks})"
+            time.sleep(0.2)
+        tiers = {s["labels"].get("tier") for s in fam["samples"]}
+        assert {"local", "cross"} <= tiers, tiers
+        eff = next(f for f in snap if f["name"] == "hvd_perf_efficiency")
+        scheds = {s["labels"].get("schedule") for s in eff["samples"]}
+        assert desc in scheds, scheds
+    hvd.barrier()
+
+    # Join/rebuild with a tiered descriptor riding the sc field: rank 0
+    # joins first and must reconstruct the same hier:<n_local>:<k>
+    # program from the echoed meta for the survivors' allreduces.
+    cfg.hierarchical_allreduce = True
+    cfg.sched_mode, cfg.sched_chunks = "decomposed", 2
+    steps = 1 if me == 0 else 3
+    for step in range(steps):
+        x = hvd.from_local(grads[me][None, :4096] + float(step))
+        out = hvd.to_numpy(hvd.allreduce(x, hvd.Average))
+        if step == 0:
+            want = (np.stack([g[:4096] for g in grads]).sum(0)) / n
+        else:
+            want = sum(g[:4096] + step for g in grads[1:]) / n
+        assert np.allclose(out, want, atol=1e-5), (me, step)
+    last = hvd.join(timeout=120)
+    assert last >= 0
+    print(f"rank {me}: HIER-OK", flush=True)
+    hvd.shutdown()
+    return 0
+
+
 if __name__ == "__main__":
+    if os.environ.get("HVDTPU_TEST_MODE") == "hier":
+        sys.exit(main_hier())
     sys.exit(main())

@@ -162,7 +162,7 @@ def test_autotuner_proposes_and_converges(tmp_path):
     for i in range(200):
         if at._done:
             break
-        t, c, m, s = at._current
+        t, c, m, s, h = at._current
         score_bias = 1.0 + (np.log2(t) - 20) * 0.1
         at.record_cycle(int(1e6 * score_bias), 0.001)
     log = (tmp_path / "at.log").read_text()
@@ -198,7 +198,7 @@ def test_autotuner_commits_exact_grid_values(tmp_path):
         # Flat-ish noisy scores: convergence picks SOME sampled config.
         at.record_cycle(int(1e6 + rng.randint(0, 1000)), 0.001)
     assert at._done, "tuner never converged"
-    t, c, m, s = at._current
+    t, c, m, s, h = at._current
     assert t in _THRESHOLDS or t == st.config.fusion_threshold
     assert st.config.fusion_threshold == t
     # The drift bug showed up in the float knob: exact membership now.
@@ -212,12 +212,15 @@ def test_autotuner_commits_exact_grid_values(tmp_path):
     else:
         assert st.config.sched_mode == "decomposed"
         assert f"rs_ag:{st.config.sched_chunks}" == s
-    # Every recorded sample keeps exact raw knobs alongside the GP coords.
-    for (rt, rc, rm, rs), (xt, xc, xm, xs) in zip(at._samples_raw,
-                                                  at._samples_X):
+    # Every recorded sample keeps exact raw knobs alongside the GP coords
+    # — all five of them, so the hierarchy dimension cannot reintroduce
+    # the round-trip drift either.
+    for (rt, rc, rm, rs, rh), (xt, xc, xm, xs, xh) in zip(at._samples_raw,
+                                                          at._samples_X):
         assert rt in _THRESHOLDS or rt == 64 * 1024 * 1024
         assert rc in _CYCLE_TIMES or rc == 2.5
         assert rs in _SCHED_MODES
+        assert rh in at._hiers
         assert 2.0 ** xt == pytest.approx(rt)
 
 
@@ -244,9 +247,52 @@ def test_autotuner_pins_sched_and_mode_when_distributed():
     at = Autotuner(st)
     assert at._modes == ["int8"]
     assert at._scheds == ["rs_ag:2"]
+    assert at._hiers == ["flat"]
     # And every grid candidate keeps them fixed.
     assert {g[2] for g in at._grid_raw} == {"int8"}
     assert {g[3] for g in at._grid_raw} == {"rs_ag:2"}
+    assert {g[4] for g in at._grid_raw} == {"flat"}
+
+
+def test_autotuner_hierarchy_dimension():
+    """The 5th knob: a detected topology split enters the search as
+    tier:<n_local> (plus its half), _apply commits the hierarchical
+    config knobs, and distributed engines pin to the configured default.
+    """
+    from horovod_tpu.utils.autotune import Autotuner
+
+    class FakeState:
+        pass
+
+    from horovod_tpu import config as config_mod
+    st = FakeState()
+    st.size = 8
+    st.local_size = 8
+    st.config = config_mod.Config(
+        autotune=True, autotune_warmup_samples=0,
+        autotune_steps_per_sample=1, local_size_env=4)
+    at = Autotuner(st)
+    assert at._hiers == ["flat", "tier:4", "tier:2"]
+    # The analytic decision table seeds the search (perfmodel).
+    assert at.split_table and {r["split"] for r in at.split_table} <= {
+        "flat", "hier"}
+    at._apply(1 << 20, 1.0, "fp32", "monolithic", "tier:2")
+    assert st.config.hierarchical_allreduce
+    assert st.config.hierarchical_local_size == 2
+    at._apply(1 << 20, 1.0, "fp32", "monolithic", "flat")
+    assert not st.config.hierarchical_allreduce
+    # Distributed + flag on: pinned to the configured tier, never "flat".
+    class FakeEngine:
+        distributed = True
+    st2 = FakeState()
+    st2.size = 8
+    st2.engine = FakeEngine()
+    st2.config = config_mod.Config(
+        autotune=True, hierarchical_allreduce=True,
+        hierarchical_local_size=4)
+    at2 = Autotuner(st2)
+    assert at2._hiers == ["tier:4"]
+    assert {g[4] for g in at2._grid_raw} == {"tier:4"}
 
 
 @pytest.mark.integration

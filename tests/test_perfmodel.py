@@ -97,6 +97,63 @@ def test_hierarchical_two_tier_split():
     assert cost.n == 8 and cost.schedule == "hier"
 
 
+def test_hierarchical_per_tier_modes_and_chunks():
+    """Each tier rides its own wire mode; chunking multiplies per-tier
+    steps, never wire bytes; mixed modes show up in the labels."""
+    B = 1 << 22
+    base = expected_hierarchical(B, 4, 2)
+    mixed = expected_hierarchical(B, 4, 2, mode="fp32", cross_mode="int8",
+                                  chunks=2)
+    # int8 DCN hop shrinks ONLY the cross tier's bytes.
+    assert mixed.tiers["local"].wire_bytes == pytest.approx(
+        base.tiers["local"].wire_bytes)
+    w_int8 = wire_per_elem("int8", 4, 512) / 8.0
+    assert mixed.tiers["cross"].wire_bytes == pytest.approx(
+        base.tiers["cross"].wire_bytes * w_int8)
+    # Chunked tiered schedule label + per-tier step multiplication.
+    assert mixed.schedule == "hier:4:2"
+    assert mixed.mode == "fp32/int8"
+    assert mixed.tiers["local"].steps == base.tiers["local"].steps * 2
+    assert mixed.tiers["cross"].steps == base.tiers["cross"].steps * 2
+    # Same mode on both tiers keeps the plain label.
+    both = expected_hierarchical(B, 4, 2, mode="int8", cross_mode="int8")
+    assert both.mode == "int8" and both.schedule == "hier"
+
+
+def test_hier_split_table_flips_flat_to_hier():
+    """Small messages pay 3x the phase-dispatch overhead and stay flat;
+    large messages win on the 1/n_local cross-tier volume."""
+    rows = perfmodel.hier_split_table(
+        [1 << 10, 1 << 16, 1 << 20, 1 << 26], 8, 4,
+        gbs_local=10.0, gbs_cross=1.0)
+    by_size = {r["payload_bytes"]: r["split"] for r in rows}
+    assert by_size[1 << 10] == "flat"
+    assert by_size[1 << 26] == "hier"
+    # Monotone once it flips: no hier->flat->hier zigzag.
+    splits = [r["split"] for r in rows]
+    assert splits == sorted(splits, key=lambda s: s == "hier")
+    for r in rows:
+        assert r["flat_seconds"] > 0 and r["hier_seconds"] > 0
+    with pytest.raises(ValueError):
+        perfmodel.hier_split_table([1 << 20], 8, 3, gbs_local=10.0,
+                                   gbs_cross=1.0)
+
+
+def test_observe_tiers_extended_keywords():
+    """The chunked+tiered executor feeds schedule/mode/chunks through
+    observe_tiers; the recorded cost carries the descriptor label."""
+    m = PerfModel()
+    m.configure(link_gbs=1.0, link_latency_us=0.0)
+    out = m.observe_tiers(
+        1 << 22, 4, 2, 0.1, tier_seconds={"local": 0.08, "cross": 0.02},
+        mode="fp32", cross_mode="int8", chunks=2, schedule="hier:4:2")
+    assert out is not None
+    fam = REGISTRY.get("hvd_perf_efficiency")
+    labels = [s["labels"] for s in fam._samples()]
+    assert any(lb.get("schedule") == "hier:4:2"
+               and lb.get("mode") == "fp32/int8" for lb in labels), labels
+
+
 # -- efficiency scoring --------------------------------------------------
 
 def test_peak_basis_self_calibrates():

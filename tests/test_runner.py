@@ -161,6 +161,26 @@ def test_hvdrun_decomposed_allreduce_parity(np_):
 
 
 @pytest.mark.integration
+def test_hvdrun_hierarchical_parity():
+    """Chunked+tiered (``hier:2:2``) vs flat allreduce over real
+    negotiated transport at np=4 as a 2x2 tier mesh (the ci.yaml
+    hierarchical-parity job): int8 BIT-exact, fp8 bounded (fp16
+    accumulator — see the worker docstring), fp32 <=2-ulp, a quantized
+    cross-tier hop under an fp32 fast tier, mixed flat+tiered fusion
+    groups, the join/rebuild path with a tiered ``sc`` descriptor, and
+    rank-labeled ``hvd_perf_tier_*`` gauges on ``/cluster``.  A
+    dispatch-counter guard inside the worker proves the tiered executor
+    ran (a silent flat fallback would make parity vacuous)."""
+    res = _hvdrun(4, [os.path.join(REPO, "tests", "mp_sched_worker.py")],
+                  timeout=360,
+                  extra_env={"HVDTPU_TEST_MODE": "hier",
+                             "HVDTPU_HIERARCHICAL_LOCAL_SIZE": "2"})
+    assert res.returncode == 0, res.stdout + res.stderr
+    for r in range(4):
+        assert f"rank {r}: HIER-OK" in res.stdout, res.stdout
+
+
+@pytest.mark.integration
 def test_hvdrun_join_uneven_inputs():
     """† test_horovod_join: rank 0 runs 3 steps, rank 1 runs 5; the job
     completes (no deadlock) and surviving-step allreduces are correct."""

@@ -183,12 +183,31 @@ def test_resolve_schedule_gates(sched_cfg):
             descriptor="rs_ag:2", precision="bf16")
     with pytest.raises(ValueError):
         res(requested="bogus")
-    # Hierarchical mode owns its own schedule: engine decomposition off.
+    # Hierarchical flag composes with decomposition.  Single-controller
+    # topology detection sees local_size == world size (no tier), so the
+    # flag alone keeps the flat descriptor; a valid explicit split
+    # upgrades decomposed requests to the chunked+tiered family.
     cfg.hierarchical_allreduce = True
+    old_ls = cfg.hierarchical_local_size
     try:
-        assert res() == ""
+        assert res() == "rs_ag:4"              # invalid split -> flat
+        cfg.hierarchical_local_size = 4
+        assert res() == "hier:4:4"
+        assert res(requested="rs_ag:2") == "hier:4:2"   # upgrade
+        assert res(requested="monolithic") == ""  # unchunked kernel path
+        # Quantized cross hop tightens the size gate to block units.
+        cfg.hierarchical_cross_precision = "int8"
+        assert res() == "hier:4:4"
+        assert res(nbytes=4 * 8 * 512) == ""   # < 2 block-aligned units
+        cfg.hierarchical_cross_precision = ""
     finally:
         cfg.hierarchical_allreduce = False
+        cfg.hierarchical_local_size = old_ls
+    # Explicit hier requests pass through without the flag; an invalid
+    # split degrades to the flat descriptor at the same chunk count.
+    assert res(requested="hier:4:2") == "hier:4:2"
+    assert res(requested="hier:3:2") == "rs_ag:2"   # 8 % 3 != 0
+    assert res(requested="hier:8:2") == "rs_ag:2"   # n_local == n
     # Default config: monolithic.
     cfg.sched_mode = "monolithic"
     assert res() == ""
