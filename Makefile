@@ -5,7 +5,8 @@
 PY ?= python
 
 .PHONY: ci native test mp-test examples bench baseline-table image \
-	autoscale-recovery perf-regress bench-trajectory hierarchical-parity
+	autoscale-recovery perf-regress bench-trajectory hierarchical-parity \
+	compiled-parity
 
 # The autoscale-recovery CI job standalone: np=4 MoE job, injected rank
 # death + SLO load spike => shrink to np=2, grow back to np=4.
@@ -25,6 +26,12 @@ horovod_tpu.serving"
 	$(PY) -m horovod_tpu.chaos.run --scenario router
 	$(PY) -m horovod_tpu.chaos.run --scenario autoscale
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# The compiled-parity CI job standalone: np=2 and np=4, compiled:rs_ag:2
+# single-program lowering vs monolithic parity, zero per-chunk dispatch
+# guard, mixed-mode meta reconciliation, fusion split, join/rebuild.
+compiled-parity:
+	$(PY) -m pytest "tests/test_runner.py::test_hvdrun_compiled_allreduce_parity" -q
 
 # The hierarchical-parity CI job standalone: np=4 as a 2x2 two-tier
 # rig, chunked+tiered hier:2:2 schedule vs flat parity, quantized cross

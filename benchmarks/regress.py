@@ -117,6 +117,13 @@ def extract_bench_row(obj: dict, round_id: str, order: int,
     if cp and cp != wp:
         wp = f"{wp}/{cp}"
     sched = obj.get("schedule") or "monolithic"
+    if sched.startswith("compiled:"):
+        # compiled:rs_ag:<k> rows fold to ONE "compiled" series: the
+        # backend is a single jitted program regardless of k (the chunk
+        # count changes layout inside the executable, not the dispatch
+        # count the series tracks), so splitting per k would fragment
+        # the history for no comparable signal.
+        sched = "compiled"
     hier = obj.get("hierarchy")
     if hier and hier != "flat" and sched == "monolithic":
         sched = hier

@@ -59,9 +59,10 @@ def _parse_cross_precision(v: str) -> str:
 
 def _parse_sched_mode(v: str) -> str:
     lv = v.strip().lower()
-    if lv not in ("monolithic", "decomposed"):
+    from .ops.sched.lower import SCHED_MODES
+    if lv not in SCHED_MODES:
         raise ValueError(
-            f"sched mode must be 'monolithic' or 'decomposed', got {v!r}")
+            f"sched mode must be one of {'/'.join(SCHED_MODES)}, got {v!r}")
     return lv
 
 
@@ -105,10 +106,13 @@ class Config:
     quant_min_bytes: int = 65536
 
     # --- collective schedule (ops/sched; GC3-style decomposition) ---
-    # Engine allreduce schedule: "monolithic" (one psum, the default) or
+    # Engine allreduce schedule: "monolithic" (one psum, the default),
     # "decomposed" (chunked reduce-scatter -> allgather, later chunks'
-    # communication overlapped with earlier chunks' compute).  Composes
-    # with wire_precision; results are bit-exact either way.
+    # communication overlapped with earlier chunks' compute, dispatched
+    # unit by unit by the executor) or "compiled" (the SAME chunked
+    # schedule lowered into one jitted NamedSharding program so XLA
+    # places/fuses/overlaps the collectives in-compiler).  Composes with
+    # wire_precision; results are bit-exact across all three.
     sched_mode: str = "monolithic"
     # Chunk count for the decomposed schedule (payloads too small to cut
     # into >= 2 chunks fall back to monolithic per resolve_schedule).

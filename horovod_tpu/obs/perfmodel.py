@@ -141,13 +141,21 @@ class ExpectedCost:
 
 def expected_allreduce(payload_bytes: int, n: int, *, mode: str = "fp32",
                        chunks: int = 1, block: int = 512,
-                       itemsize: int = 4) -> ExpectedCost:
+                       itemsize: int = 4,
+                       compiled: bool = False) -> ExpectedCost:
     """Monolithic (chunks=1) or rs_ag-decomposed (chunks=k) allreduce.
 
     Chunking does not change total wire bytes — every chunk still rides
     a full reduce-scatter + allgather ring — but it multiplies latency
     steps (each chunk pays its own 2*(n-1) hops) while buying the
     executor room to overlap chunk c+1's comm under chunk c's compute.
+
+    ``compiled=True`` models the single-program GSPMD backend: the same
+    wire bytes, but the per-chunk dispatch latency collapses back to one
+    ring's 2*(n-1) steps — XLA pipelines the chunks inside one
+    executable, so the host pays one dispatch regardless of k.  That
+    deleted ``(k-1) * 2*(n-1)`` step term is exactly the dispatch-bound
+    overhead the compiled path exists to remove.
     """
     if n < 1 or payload_bytes < 0:
         raise ValueError(f"bad inputs n={n} bytes={payload_bytes}")
@@ -156,8 +164,12 @@ def expected_allreduce(payload_bytes: int, n: int, *, mode: str = "fp32",
     frac = (n - 1) / n if n > 1 else 0.0
     wire = frac * wire_per_elem(mode, itemsize, block) * numel
     k = max(1, int(chunks))
-    steps = 2 * (n - 1) * k if n > 1 else 0
-    sched = "monolithic" if k == 1 else f"rs_ag:{k}"
+    if compiled:
+        steps = 2 * (n - 1) if n > 1 else 0
+        sched = f"compiled:rs_ag:{k}"
+    else:
+        steps = 2 * (n - 1) * k if n > 1 else 0
+        sched = "monolithic" if k == 1 else f"rs_ag:{k}"
     return ExpectedCost(verb="allreduce", mode=mode, schedule=sched,
                         n=n, payload_bytes=payload_bytes, wire_bytes=wire,
                         steps=steps, busbw_factor=busbw_factor(
@@ -385,7 +397,8 @@ class PerfModel:
             seconds = t1 - t0
             cost = expected_allreduce(
                 payload_bytes, n, mode=mode, chunks=max(1, chunks),
-                block=block, itemsize=itemsize)
+                block=block, itemsize=itemsize,
+                compiled=(descriptor or "").startswith("compiled:"))
             if descriptor:
                 cost = dataclasses.replace(cost, schedule=descriptor)
             row = self.record(cost, seconds)

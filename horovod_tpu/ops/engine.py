@@ -192,8 +192,8 @@ def _parse_joinable_meta(meta: str) -> Optional[dict]:
             from .sched import known_descriptor
             if not known_descriptor(m["sc"]):
                 # Unknown schedule lowering from a version-skewed peer
-                # (neither rs_ag:<k> nor hier:<n_local>:<k>): same rule
-                # — skip, don't crash the cycle.
+                # (not rs_ag:<k>, hier:<n_local>:<k> or
+                # compiled:rs_ag:<k>): same rule — skip, don't crash.
                 return None
     except (ValueError, TypeError, KeyError):
         return None
@@ -599,6 +599,7 @@ class CollectiveEngine:
         if deferred:
             with self._lock:
                 self._queue = deferred + self._queue
+        self._reconcile_metas(ready, by_name, outcome.metas)
         for group in self._fuse(ready):
             self._execute_group(group, handles)
         _m_cycle.observe(time.monotonic() - t0)
@@ -621,6 +622,54 @@ class CollectiveEngine:
         if self._autotuner is not None:
             payload = sum(self._entry_bytes(e) for e in ready)
             self._autotuner.record_cycle(payload, time.monotonic() - t0)
+
+    def _reconcile_metas(self, ready: list[TensorTableEntry],
+                         by_name: dict, metas: dict) -> None:
+        """Adopt the coordinator's echoed schedule/wire-mode for locally
+        held ready entries whose own resolution differs.
+
+        Both fields are normally deterministic in synchronized config, so
+        every rank resolves the same values and this is a no-op.  But a
+        deliberately skewed fleet — one rank pinned
+        ``HOROVOD_TPU_SCHED_MODE=compiled``, a peer ``decomposed`` —
+        would otherwise dispatch *different executables* for the same
+        collective, which cannot work at all: under ``jax.distributed``
+        the collective channel IDs are assigned per-executable, so a
+        compiled rank and a dispatched rank would rendezvous on nothing
+        and hang.  The coordinator stores ONE meta per tensor (lowest
+        submitting rank wins — see native ``RecordName``) and echoes it
+        identically to every rank, so adopting the echoed value here —
+        before fusion, which keys on the descriptor — is the only sound
+        reconciliation: any rule must be independent of the local value,
+        because the rank whose meta was stored sees no mismatch.  An
+        unparseable echoed meta keeps the local resolution (that peer
+        skips the entry by the :func:`_parse_joinable_meta` rule, so
+        nothing dispatches against us).
+        """
+        if not metas:
+            return
+        for e in ready:
+            if (e.verb != "allreduce" or e.process_set is not None
+                    or by_name.get(e.name) is not e):
+                continue
+            raw = metas.get(e.name)
+            if raw is None or raw == e.meta():
+                continue
+            m = _parse_joinable_meta(raw)
+            if m is None:
+                continue
+            sc = m.get("sc", "")
+            wp = m.get("wp", "")
+            if sc != e.schedule or wp != (
+                    e.precision if e.precision != "fp32" else ""):
+                log.info(
+                    "adopting negotiated meta for %r: schedule %r -> %r, "
+                    "wire %r -> %r (peer resolutions differed; one "
+                    "executable per collective is mandatory)", e.name,
+                    e.schedule or "monolithic", sc or "monolithic",
+                    e.precision or "fp32", wp or "fp32")
+                e.schedule = sc
+                e.precision = wp
 
     # -- join († RequestType::JOIN, hvd.join()) ------------------------------
     def join(self, timeout: Optional[float] = None) -> int:

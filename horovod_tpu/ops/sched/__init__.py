@@ -13,12 +13,16 @@ collective engine.  Four pieces:
   interpreter the hierarchical path rides).
 
 Mode selection mirrors the wire-precision convention: the engine default
-comes from ``HOROVOD_TPU_SCHED_MODE`` (``monolithic``/``decomposed``) +
+comes from ``HOROVOD_TPU_SCHED_MODE``
+(``monolithic``/``decomposed``/``compiled``) +
 ``HOROVOD_TPU_SCHED_CHUNKS``; :func:`resolve_schedule` turns it into a
-concrete descriptor (``"rs_ag:4"``) deterministically from values every
-rank agrees on, and the descriptor rides the negotiation meta (``sc``
-field, next to ``wp``) so joined/zero-participation ranks rebuild
-identical programs.
+concrete descriptor (``"rs_ag:4"``, ``"compiled:rs_ag:4"``)
+deterministically from values every rank agrees on, and the descriptor
+rides the negotiation meta (``sc`` field, next to ``wp``) so
+joined/zero-participation ranks rebuild identical programs.  The
+``compiled`` family executes the same schedule as one jitted
+NamedSharding program (:mod:`.compiled`) instead of the executor's
+dispatch walk — XLA owns placement, fusion and overlap.
 """
 
 from __future__ import annotations
@@ -28,13 +32,16 @@ from typing import Any
 from .ir import KINDS, Schedule, ScheduleError, Step  # noqa: F401
 from .lower import (  # noqa: F401
     SCHED_MODES,
+    autotune_sched_arms,
     chunk_layout,
+    compiled_descriptor,
     descriptor,
     hier_descriptor,
     known_descriptor,
     lower_allreduce,
     lower_hierarchical,
     lower_hierarchical_chunked,
+    parse_compiled_descriptor,
     parse_descriptor,
     parse_hier_descriptor,
 )
@@ -84,18 +91,24 @@ def resolve_schedule(requested: str, verb: str, op: Any, dtype: Any,
     req = requested or getattr(cfg, "sched_mode", "monolithic") \
         or "monolithic"
     hier_req = None     # explicit hier:<n_local>:<k> request
+    compiled = False    # compiled (single-program GSPMD) backend
     if req == "monolithic":
         return ""
-    if req == "decomposed":
+    if req in ("decomposed", "compiled"):
         k = max(1, int(getattr(cfg, "sched_chunks", 4)))
+        compiled = req == "compiled"
     else:
         k = parse_descriptor(req)
+        if k is None:
+            k = parse_compiled_descriptor(req)
+            compiled = k is not None
         if k is None:
             hier_req = parse_hier_descriptor(req)
             if hier_req is None:
                 raise ValueError(
                     f"unknown schedule {req!r}; expected 'monolithic', "
-                    "'decomposed', 'rs_ag:<chunks>' or "
+                    "'decomposed', 'compiled', 'rs_ag:<chunks>', "
+                    "'compiled:rs_ag:<chunks>' or "
                     "'hier:<n_local>:<chunks>'")
             k = hier_req[1]
     if verb != "allreduce" or n <= 1 or k < 2:
@@ -137,5 +150,28 @@ def resolve_schedule(requested: str, verb: str, op: Any, dtype: Any,
     if numel < 2 * unit:
         return ""
     if n_local:
+        # Hierarchical schedules have no compiled lowering yet (the
+        # tiered kernel would need a compiled twin over the 2-D mesh).
+        # Fall back to the DISPATCHED hier family — deterministically on
+        # every rank — and log the reason once per process.
+        if compiled:
+            _warn_hier_fallback(n_local, k)
         return hier_descriptor(n_local, k)
+    if compiled:
+        return compiled_descriptor(k)
     return descriptor(k)
+
+
+_HIER_FALLBACK_WARNED = set()
+
+
+def _warn_hier_fallback(n_local: int, k: int) -> None:
+    key = (n_local, k)
+    if key in _HIER_FALLBACK_WARNED:
+        return
+    _HIER_FALLBACK_WARNED.add(key)
+    from ...utils import logging as hvd_logging
+    hvd_logging.get_logger().info(
+        "sched: compiled mode has no hierarchical lowering yet; "
+        "falling back to dispatched hier:%d:%d (deterministic on all "
+        "ranks)", n_local, k)

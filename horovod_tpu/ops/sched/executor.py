@@ -49,7 +49,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ...obs import REGISTRY as _obs
 from ...obs import perfmodel as _perf
 from .. import reduction as R
-from .lower import chunk_layout, parse_descriptor, parse_hier_descriptor
+from .lower import (chunk_layout, parse_compiled_descriptor,
+                    parse_descriptor, parse_hier_descriptor)
 
 _m_overlap = _obs.gauge(
     "hvd_sched_overlap_fraction",
@@ -539,6 +540,15 @@ def execute_allreduce(xs: Sequence[Any], op, *, descriptor: str,
     from ... import context as ctx_mod
     chunks = parse_descriptor(descriptor)
     if chunks is None:
+        if parse_compiled_descriptor(descriptor) is not None:
+            # Single-program GSPMD backend: same schedule, no dispatch
+            # walk — _m_sched stays untouched on this path (the CI
+            # zero-dispatch guard rests on that).
+            from . import compiled as CP
+            return CP.execute_allreduce(
+                xs, op, descriptor=descriptor, precision=precision,
+                prescale=prescale, postscale=postscale,
+                process_set=process_set, name=name)
         if parse_hier_descriptor(descriptor) is not None:
             return _execute_hier_allreduce(
                 xs, op, descriptor=descriptor, precision=precision,

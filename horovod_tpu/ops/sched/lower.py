@@ -17,16 +17,20 @@ from typing import Optional
 
 from .ir import Schedule, _Builder
 
-#: Descriptor grammar for negotiation metas: two schedule families ride
-#: the ``sc`` field — the chunked reduce-scatter/allgather decomposition
-#: (``rs_ag:<k>``) and the chunked+tiered two-level allreduce
-#: (``hier:<n_local>:<k>``).  Unknown descriptors from version-skewed
-#: peers must be rejected (parse -> None), never guessed at.
+#: Descriptor grammar for negotiation metas: three schedule families
+#: ride the ``sc`` field — the chunked reduce-scatter/allgather
+#: decomposition (``rs_ag:<k>``), the chunked+tiered two-level allreduce
+#: (``hier:<n_local>:<k>``), and the compiled GSPMD lowering of the flat
+#: family (``compiled:rs_ag:<k>`` — same schedule, executed as ONE
+#: jitted program instead of the executor's dispatch-unit walk).
+#: Unknown descriptors from version-skewed peers must be rejected
+#: (parse -> None), never guessed at.
 _DESC_RE = re.compile(r"^rs_ag:(\d+)$")
 _HIER_DESC_RE = re.compile(r"^hier:(\d+):(\d+)$")
+_COMPILED_DESC_RE = re.compile(r"^compiled:rs_ag:(\d+)$")
 
 #: Schedule-mode config values (``HOROVOD_TPU_SCHED_MODE``).
-SCHED_MODES = ("monolithic", "decomposed")
+SCHED_MODES = ("monolithic", "decomposed", "compiled")
 
 
 def parse_descriptor(desc: str) -> Optional[int]:
@@ -70,11 +74,56 @@ def hier_descriptor(n_local: int, chunks: int) -> str:
     return f"hier:{int(n_local)}:{int(chunks)}"
 
 
+def parse_compiled_descriptor(desc: str) -> Optional[int]:
+    """``"compiled:rs_ag:<k>"`` -> chunk count k, or None.
+
+    The compiled sibling of :func:`parse_descriptor`: the schedule lowered
+    is byte-identical to the flat ``rs_ag:<k>`` family's, but the backend
+    is one jitted NamedSharding program (XLA places and fuses the
+    collectives) instead of the executor's per-unit dispatch walk.  The
+    backend choice rides the descriptor because every process MUST run
+    the same executable — under ``jax.distributed`` the per-collective
+    channel IDs are assigned per-program, so a compiled rank and a
+    dispatched rank would rendezvous on nothing.
+    """
+    m = _COMPILED_DESC_RE.match(desc or "")
+    if not m:
+        return None
+    k = int(m.group(1))
+    return k if k >= 1 else None
+
+
+def compiled_descriptor(chunks: int) -> str:
+    return f"compiled:rs_ag:{int(chunks)}"
+
+
 def known_descriptor(desc: str) -> bool:
     """True when ``desc`` belongs to a schedule family this build can
     lower — the negotiation meta's validity check for the ``sc`` field."""
     return (parse_descriptor(desc) is not None or
-            parse_hier_descriptor(desc) is not None)
+            parse_hier_descriptor(desc) is not None or
+            parse_compiled_descriptor(desc) is not None)
+
+
+def autotune_sched_arms(chunk_counts=(2, 4)) -> list:
+    """The autotuner's schedule-dimension arm set, derived from
+    :data:`SCHED_MODES` so the two can never drift apart (adding a mode
+    here grows the grid automatically; tests assert the sync).
+
+    ``monolithic`` contributes itself; ``decomposed`` contributes one
+    flat ``rs_ag:<k>`` arm per candidate chunk count; ``compiled``
+    contributes the compiled twin of each.  Hier arms are seeded
+    separately from the split table (topology-, not mode-, derived).
+    """
+    arms = []
+    for mode in SCHED_MODES:
+        if mode == "monolithic":
+            arms.append("monolithic")
+        elif mode == "decomposed":
+            arms.extend(descriptor(k) for k in chunk_counts)
+        elif mode == "compiled":
+            arms.extend(compiled_descriptor(k) for k in chunk_counts)
+    return arms
 
 
 def chunk_layout(numel: int, n: int, chunks: int, mode: str,

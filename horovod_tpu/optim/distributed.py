@@ -59,12 +59,14 @@ def _reduce_in_context(g, axis_name: str, op: C.ReduceOp,
     ``pmax``, then one ``psum`` of the narrow accumulator — 2B/elem on
     the wire instead of 4 (see :mod:`ops.reduction`).  Adasum never
     quantizes (dot-product projections amplify the error).  Under
-    ``sched_mode="decomposed"`` (``HVDTPU_SCHED_MODE`` /
-    ``HOROVOD_TPU_SCHED_MODE``) the fp32 and quant paths route through
+    ``sched_mode="decomposed"`` or ``"compiled"`` (``HVDTPU_SCHED_MODE``
+    / ``HOROVOD_TPU_SCHED_MODE``) the fp32 and quant paths route through
     :func:`ops.sched.overlap_allreduce` instead — the allreduce becomes
-    chunked reduce-scatter/allgather chains XLA can overlap with the
-    surrounding arithmetic; bf16/fp16 cast modes stay monolithic, same
-    rule as the engine resolver.
+    chunked reduce-scatter/allgather chains inside the step's one jitted
+    program (for ``compiled`` this IS the single-program contract; for
+    ``decomposed`` XLA may still overlap them with the surrounding
+    arithmetic); bf16/fp16 cast modes stay monolithic, same rule as the
+    engine resolver.
     """
     g_arr = jnp.asarray(g)
     quant = routes_engine_side(compression)
@@ -79,12 +81,18 @@ def _reduce_in_context(g, axis_name: str, op: C.ReduceOp,
         big = int(g_arr.size) * g_arr.dtype.itemsize >= cfg.quant_min_bytes
         # Sub-floor leaves ride fp32, same as the engine path's resolver.
         mode = compression.wire_mode if (quant and big) else "fp32"
-        if cfg.sched_mode == "decomposed":
+        if cfg.sched_mode in ("decomposed", "compiled"):
             # Same eligibility rules as the engine's resolve_schedule:
             # only fp32 and the quant wire modes decompose (bf16/fp16
             # cast stays monolithic — see its docstring), so the
             # gradient allreduce inside a jitted train step chunks into
-            # reduce-scatter/allgather chains XLA can overlap.
+            # reduce-scatter/allgather chains XLA can overlap.  The
+            # compiled mode takes the same in-graph chains: inside a
+            # jitted train step the whole step ALREADY IS one program —
+            # this branch is the compiled path end to end, with zero
+            # engine dispatches (the CI compiled-parity job asserts the
+            # per-chunk dispatch counter stays at 0), and only the eager
+            # engine route differs between the two modes.
             from ..ops.sched import overlap_allreduce
             return overlap_allreduce(
                 g_arr, axis_name, average=op is C.ReduceOp.AVERAGE,

@@ -15,8 +15,10 @@ low-noise search space.
 Knob space, v4: 5-D.  Beyond the reference's (threshold, cycle-time),
 the third dimension is the engine's **wire precision**
 (``ops/reduction.py``): fp32, bf16, or block-scaled int8; the fourth is
-the **collective schedule** (``ops/sched``): monolithic vs the
-decomposed reduce-scatter/allgather pipeline at a candidate chunk count;
+the **collective schedule** (``ops/sched``, arm set derived from
+``lower.SCHED_MODES``): monolithic, the dispatched decomposed
+reduce-scatter/allgather pipeline at a candidate chunk count, or its
+compiled single-program twin (``compiled:rs_ag:<k>``);
 the fifth is the **hierarchy split** (``ops/hierarchical`` + the sched
 executor's ``hier:<n_local>:<k>`` path): flat, the topology-detected
 two-tier split, or the detected split halved — HiCCL's level-split
@@ -62,13 +64,27 @@ from ..obs import REGISTRY as _obs
 _THRESHOLDS = [1 << p for p in range(20, 28)]         # 1 MB .. 128 MB
 _CYCLE_TIMES = [0.5, 1.0, 2.5, 5.0, 10.0, 20.0]        # ms
 _WIRE_MODES = ["fp32", "bf16", "int8"]
-# Schedule dimension (ops/sched): monolithic vs decomposed at the chunk
-# counts worth searching (higher counts add dispatch overhead faster than
-# they add overlap window; 2 and 4 bracket the useful range).
-_SCHED_MODES = ["monolithic", "rs_ag:2", "rs_ag:4"]
+# Schedule dimension (ops/sched): one arm set, DERIVED from
+# lower.SCHED_MODES so adding a sched mode grows the grid automatically
+# (tests/test_autotune.py asserts the sync) — monolithic, the dispatched
+# decomposition and its compiled twin at the chunk counts worth
+# searching (higher counts add dispatch overhead faster than they add
+# overlap window; 2 and 4 bracket the useful range).
+_SCHED_CHUNK_COUNTS = (2, 4)
+
+
+def _sched_arms() -> list:
+    from ..ops.sched import autotune_sched_arms
+    return autotune_sched_arms(_SCHED_CHUNK_COUNTS)
 # GP-space spacing between adjacent modes; comparable to one grid step in
 # the log2-threshold dimension so no dimension dominates the RBF distance.
 _MODE_SCALE = 2.0
+# Cycles discarded right after a knob commit before scoring resumes.  The
+# first cycles under a new config pay XLA compiles for the new fused (and,
+# on the compiled-schedule arms, whole-program) signatures; scoring that
+# stall grades the warm incumbent against cold challengers, so the initial
+# config would win every search on compile overhead alone.
+_SETTLE_CYCLES = 2
 
 _m_trials = _obs.counter(
     "hvd_autotune_trials_total", "knob configurations scored by the tuner")
@@ -154,11 +170,17 @@ class Autotuner:
         # Schedule dimension, pinned in multi-process jobs for the same
         # reason as the wire mode (module docstring): a per-rank
         # sched_mode/sched_chunks commit diverges the enqueue-time
-        # schedule resolution across ranks.
-        sched_default = ("monolithic"
-                         if getattr(cfg, "sched_mode", "monolithic")
-                         != "decomposed"
-                         else f"rs_ag:{max(1, cfg.sched_chunks)}")
+        # schedule resolution across ranks.  (The engine's meta
+        # reconciliation would converge the fleet anyway, but onto ONE
+        # rank's proposal — the other ranks' scores would then grade a
+        # config they never ran.)
+        cfg_mode = getattr(cfg, "sched_mode", "monolithic")
+        if cfg_mode == "decomposed":
+            sched_default = f"rs_ag:{max(1, cfg.sched_chunks)}"
+        elif cfg_mode == "compiled":
+            sched_default = f"compiled:rs_ag:{max(1, cfg.sched_chunks)}"
+        else:
+            sched_default = "monolithic"
         # Hierarchy dimension (HiCCL level split): "flat" plus the
         # topology-detected two-tier split and the detected split halved
         # ("tier:<n_local>"), when they actually tier this world size.
@@ -187,10 +209,11 @@ class Autotuner:
             self._scheds = [sched_default]
             self._hiers = [hier_default]
         else:
+            sched_arms = _sched_arms()
             self._modes = _WIRE_MODES + (
                 [default] if default not in _WIRE_MODES else [])
-            self._scheds = _SCHED_MODES + (
-                [sched_default] if sched_default not in _SCHED_MODES
+            self._scheds = sched_arms + (
+                [sched_default] if sched_default not in sched_arms
                 else [])
             self._hiers = hier_vals + (
                 [hier_default] if hier_default not in hier_vals else [])
@@ -232,6 +255,7 @@ class Autotuner:
         self._acc_bytes = 0
         self._acc_time = 0.0
         self._acc_cycles = 0
+        self._settle_left = 0
         self._done = False
 
     def record_cycle(self, payload_bytes: int, cycle_seconds: float) -> None:
@@ -239,6 +263,9 @@ class Autotuner:
         payload (entry bytes, not wire bytes) so the score is effective
         throughput and precision modes compete on delivered gradients."""
         if self._done or payload_bytes == 0:
+            return
+        if self._settle_left > 0:
+            self._settle_left -= 1
             return
         self._acc_bytes += payload_bytes
         self._acc_time += cycle_seconds
@@ -292,13 +319,21 @@ class Autotuner:
 
     def _apply(self, threshold: int, cycle_ms: float, mode: str,
                sched: str, hier: str) -> None:
-        from ..ops.sched import parse_descriptor
+        from ..ops.sched import parse_compiled_descriptor, parse_descriptor
         self._current = (threshold, cycle_ms, mode, sched, hier)
+        self._settle_left = _SETTLE_CYCLES
         self._state.config.fusion_threshold = threshold
         self._state.config.cycle_time_ms = cycle_ms
         self._state.config.wire_precision = mode
+        ck = parse_compiled_descriptor(sched)
         if sched == "monolithic":
             self._state.config.sched_mode = "monolithic"
+        elif ck is not None:
+            # Compiled-vs-dispatched is an ARM of the search, not a
+            # preprocessing choice: the GP scores the single-program
+            # backend against the executor walk per signature.
+            self._state.config.sched_mode = "compiled"
+            self._state.config.sched_chunks = ck
         else:
             self._state.config.sched_mode = "decomposed"
             self._state.config.sched_chunks = parse_descriptor(sched)

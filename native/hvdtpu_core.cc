@@ -598,6 +598,14 @@ struct TensorState {
   uint32_t id;
   std::string name;
   std::string meta;
+  // Rank whose submission supplied the stored meta this cycle.  Meta
+  // storage is lowest-rank-wins within a submission cycle (RecordName):
+  // the echoed meta is then a *deterministic* function of the fleet's
+  // submissions, independent of TCP arrival order — required for
+  // schedule-backend reconciliation (engine adopts the echoed `sc`), so
+  // a mixed compiled/decomposed fleet converges on the same common mode
+  // every run, not whichever rank's packet landed last.
+  uint32_t meta_rank = 0;
   // Global ranks participating in this tensor's collective; empty = every
   // rank († ProcessSet membership).  Readiness and join coverage are
   // computed against this set.
@@ -777,6 +785,7 @@ class Controller {
       st.id = id;
       st.name = name;
       st.meta = meta;
+      st.meta_rank = rank;
       st.members = parse_members(members);
       st.first_seen_round = round_;
       st.first_seen_time = Clock::now();
@@ -791,8 +800,17 @@ class Controller {
       // e.g. a tail batch with a new shape, or a name reused for a
       // non-joinable collective).  Keeping the echoed meta identical to
       // what the submitting ranks hold this round is what lets joined and
-      // live ranks agree on joinability.
-      st.meta = meta;
+      // live ranks agree on joinability.  Within one submission cycle
+      // the LOWEST submitting rank's meta wins: when peers disagree
+      // (schedule-mode skew — one rank resolved compiled, another
+      // decomposed), the echoed meta the engines adopt must not depend
+      // on packet arrival order, or the reconciled common mode would
+      // flap run to run.
+      bool fresh = st.ranks_seen.empty();
+      if (fresh || rank <= st.meta_rank) {
+        st.meta = meta;
+        st.meta_rank = rank;
+      }
       st.members = parse_members(members);
       Touch(st, rank);
     }

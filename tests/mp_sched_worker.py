@@ -43,6 +43,13 @@ vacuous) with the per-family contract:
 plus mixed flat+tiered fusion groups in one cycle, the join/rebuild
 path with a tiered ``sc`` descriptor, and rank-labeled
 ``hvd_perf_tier_*`` gauges on the aggregated ``/cluster`` view.
+
+``HVDTPU_TEST_MODE=compiled`` (np=2 and np=4, the ci.yaml
+compiled-parity job) runs the compiled single-program battery instead
+— same parity contract as the decomposed one, plus the zero
+per-chunk-dispatch guard and a mixed-mode phase where the coordinator's
+echoed meta reconciles compiled- and decomposed-pinned ranks onto one
+backend (see :func:`main_compiled`).
 """
 
 import os
@@ -271,7 +278,126 @@ def main_hier() -> int:
     return 0
 
 
+def main_compiled() -> int:
+    """Compiled single-program backend over the negotiated transport.
+
+    ``HVDTPU_TEST_MODE=compiled`` (np=2 and np=4 in the ci.yaml
+    compiled-parity job).  Same parity contract as the decomposed
+    battery — quantized modes bit-exact at any n, fp32 bit-exact at
+    np=2 / <= 2 ulp at np>=4 — plus the two compiled-specific
+    invariants:
+
+    - the engine's per-chunk dispatch counter NEVER moves: every
+      compiled collective is one cached jitted program (the counter is
+      checked after each phase and must read 0 at exit);
+    - mixed-mode peers converge: one rank pins ``compiled``, another
+      ``decomposed``, and the coordinator's lowest-rank-wins echoed
+      meta reconciles every process onto ONE descriptor before fusion
+      (divergent backends deadlock on per-executable channel IDs, so
+      completion + the counter split IS the assertion).
+    """
+    from horovod_tpu.ops.sched.compiled import _m_compiled
+    from horovod_tpu.ops.sched.executor import _m_sched
+
+    hvd.init()
+    me, n = hvd.rank(), hvd.size()
+    cfg = hvd.global_state().config
+    cfg.quant_min_bytes = 0
+    entry = max(2048, 2 * n * cfg.quant_block_size)
+    numel = 4 * entry
+    grads = [np.random.RandomState(400 + r).randn(numel).astype(np.float32)
+             for r in range(n)]
+
+    def run(mode, tag):
+        hs = [hvd.allreduce_async(
+            hvd.from_local(grads[me][None, i * entry:(i + 1) * entry]),
+            hvd.Average, name=f"c.{tag}.{i}", compression=mode or None)
+            for i in range(4)]
+        return np.concatenate(
+            [hvd.to_numpy(hvd.synchronize(h)) for h in hs])
+
+    for mode in ("", "int8", "fp8"):
+        cfg.sched_mode = "monolithic"
+        ref = run(mode, f"mono.{mode or 'fp32'}")
+        cfg.sched_mode, cfg.sched_chunks = "compiled", 2
+        before = _m_compiled.total()
+        got = run(mode, f"cmp.{mode or 'fp32'}")
+        assert _m_compiled.total() > before, (
+            f"{mode or 'fp32'}: compiled pass never hit the compiled "
+            "backend (size gate fallback?) — parity would be vacuous")
+        if mode or n == 2:
+            assert np.array_equal(ref, got), (
+                mode or "fp32", np.abs(ref - got).max())
+            tag = "bit-exact"
+        else:
+            rel = np.abs(ref - got).max() / max(1e-30, np.abs(ref).max())
+            assert rel <= 2 * np.finfo(np.float32).eps, rel
+            tag = f"ulp-bounded rel={rel:.1e}"
+        assert _m_sched.total() == 0, (
+            "compiled battery leaked per-chunk engine dispatches")
+        print(f"rank {me}: {mode or 'fp32'} compiled {tag}", flush=True)
+
+    # Mixed-mode fusion group: rank 0 pins compiled, the last rank pins
+    # decomposed, everyone else monolithic-defaults to compiled.  The
+    # coordinator echoes rank 0's meta (lowest-rank-wins), every process
+    # adopts it before fusion, and the group dispatches through the
+    # compiled backend on ALL ranks — including the one that asked for
+    # the per-chunk walk.
+    cfg.sched_mode = "decomposed" if me == n - 1 else "compiled"
+    cfg.sched_chunks = 2
+    before = _m_compiled.total()
+    x = hvd.from_local(grads[me][None, :4096])
+    h = hvd.allreduce_async(x, hvd.Average, name="c.mixmode")
+    out = hvd.to_numpy(hvd.synchronize(h))
+    want = np.stack([g[:4096] for g in grads]).mean(0)
+    if n == 2:
+        assert np.array_equal(out, want)
+    else:
+        assert np.allclose(out, want, atol=1e-5)
+    assert _m_compiled.total() > before, (
+        "mixed-mode group did not reconcile onto rank 0's compiled "
+        "descriptor")
+    assert _m_sched.total() == 0, (
+        "decomposed-pinned rank dispatched per-chunk instead of adopting "
+        "the echoed compiled descriptor")
+    print(f"rank {me}: mixed-mode reconciled to compiled", flush=True)
+
+    # Compiled + monolithic entries in one cycle still split into
+    # consistent fusion groups on every rank.
+    cfg.sched_mode = "compiled"
+    ha = hvd.allreduce_async(hvd.from_local(grads[me][None, :4096]),
+                             hvd.Average, name="c.mix.cmp")
+    cfg.sched_mode = "monolithic"
+    hb = hvd.allreduce_async(hvd.from_local(grads[me][None, :64]),
+                             hvd.Average, name="c.mix.mono")
+    hvd.synchronize(ha)
+    hvd.synchronize(hb)
+
+    # Join/rebuild: rank 0 joins first and must rebuild the SAME compiled
+    # program from the echoed meta's sc="compiled:rs_ag:2" field for the
+    # survivors' allreduces.
+    cfg.sched_mode, cfg.sched_chunks = "compiled", 2
+    steps = 1 if me == 0 else 3
+    for step in range(steps):
+        x = hvd.from_local(grads[me][None, :4096] + float(step))
+        out = hvd.to_numpy(hvd.allreduce(x, hvd.Average))
+        if step == 0:
+            want = (np.stack([g[:4096] for g in grads]).sum(0)) / n
+        else:
+            want = sum(g[:4096] + step for g in grads[1:]) / n
+        assert np.allclose(out, want, atol=1e-5), (me, step)
+    last = hvd.join(timeout=120)
+    assert last >= 0
+    assert _m_sched.total() == 0, (
+        "per-chunk dispatch counter moved during the compiled battery")
+    print(f"rank {me}: COMPILED-OK", flush=True)
+    hvd.shutdown()
+    return 0
+
+
 if __name__ == "__main__":
     if os.environ.get("HVDTPU_TEST_MODE") == "hier":
         sys.exit(main_hier())
+    if os.environ.get("HVDTPU_TEST_MODE") == "compiled":
+        sys.exit(main_compiled())
     sys.exit(main())

@@ -180,7 +180,7 @@ def test_autotuner_commits_exact_grid_values(tmp_path):
     joins the same assertion so the knob-space growth cannot reintroduce
     the drift through a new code path."""
     from horovod_tpu.utils.autotune import (
-        Autotuner, _CYCLE_TIMES, _SCHED_MODES, _THRESHOLDS, _WIRE_MODES)
+        Autotuner, _CYCLE_TIMES, _sched_arms, _THRESHOLDS, _WIRE_MODES)
 
     class FakeState:
         pass
@@ -206,9 +206,13 @@ def test_autotuner_commits_exact_grid_values(tmp_path):
     assert st.config.cycle_time_ms == c
     assert m in _WIRE_MODES
     assert st.config.wire_precision == m
-    assert s in _SCHED_MODES
+    arms = _sched_arms()
+    assert s in arms
     if s == "monolithic":
         assert st.config.sched_mode == "monolithic"
+    elif s.startswith("compiled:"):
+        assert st.config.sched_mode == "compiled"
+        assert f"compiled:rs_ag:{st.config.sched_chunks}" == s
     else:
         assert st.config.sched_mode == "decomposed"
         assert f"rs_ag:{st.config.sched_chunks}" == s
@@ -219,9 +223,116 @@ def test_autotuner_commits_exact_grid_values(tmp_path):
                                                           at._samples_X):
         assert rt in _THRESHOLDS or rt == 64 * 1024 * 1024
         assert rc in _CYCLE_TIMES or rc == 2.5
-        assert rs in _SCHED_MODES
+        assert rs in arms
         assert rh in at._hiers
         assert 2.0 ** xt == pytest.approx(rt)
+
+
+def test_autotune_sched_arms_track_lowering_modes():
+    """Regression for the arm-set drift bug: the tuner's schedule arms
+    used to be a hand-maintained list disjoint from ``lower.SCHED_MODES``
+    (it searched ``rs_ag:*`` strings while the config validator accepted
+    a different vocabulary).  The arms are now DERIVED from SCHED_MODES;
+    this test pins the sync so a new sched mode cannot ship without an
+    autotune arm, and every generated arm round-trips through the
+    resolver's descriptor parsers and ``_apply``."""
+    from horovod_tpu.ops.sched import known_descriptor
+    from horovod_tpu.ops.sched.lower import (SCHED_MODES,
+                                             autotune_sched_arms)
+    from horovod_tpu.utils.autotune import _SCHED_CHUNK_COUNTS, _sched_arms
+
+    arms = _sched_arms()
+    assert arms == autotune_sched_arms(_SCHED_CHUNK_COUNTS)
+    # Every declared sched mode contributes at least one arm...
+    assert "monolithic" in SCHED_MODES and "monolithic" in arms
+    for k in _SCHED_CHUNK_COUNTS:
+        assert ("decomposed" not in SCHED_MODES) or f"rs_ag:{k}" in arms
+        assert ("compiled" not in SCHED_MODES) \
+            or f"compiled:rs_ag:{k}" in arms
+    # ...and no arm exists the engine's resolver cannot parse.
+    for a in arms:
+        assert a == "monolithic" or known_descriptor(a), a
+    # _apply commits every arm to a config the validator accepts.
+    from horovod_tpu import config as config_mod
+
+    class FakeState:
+        pass
+
+    from horovod_tpu.utils.autotune import Autotuner
+    st = FakeState()
+    st.config = config_mod.Config(autotune=True, autotune_warmup_samples=0,
+                                  autotune_steps_per_sample=1)
+    at = Autotuner(st)
+    for a in arms:
+        at._apply(1 << 20, 1.0, "fp32", a, "flat")
+        assert st.config.sched_mode in SCHED_MODES
+        if a.startswith("compiled:"):
+            assert st.config.sched_mode == "compiled"
+        elif a == "monolithic":
+            assert st.config.sched_mode == "monolithic"
+        else:
+            assert st.config.sched_mode == "decomposed"
+
+
+def test_autotuner_discards_settle_cycles_after_commit(tmp_path):
+    """A knob commit pays XLA compiles on its first cycles — new fused
+    signatures, and on the compiled-schedule arms a whole new program.
+    Those cycles must be discarded, not scored: counting them grades the
+    warm incumbent against cold challengers, and the tuner converges
+    right back onto the (deliberately bad) starting knobs because every
+    challenger's window is poisoned by its own compile stall."""
+    from horovod_tpu.utils.autotune import _SETTLE_CYCLES, Autotuner
+
+    class FakeState:
+        pass
+
+    from horovod_tpu import config as config_mod
+    st = FakeState()
+    st.config = config_mod.Config(autotune=True, autotune_warmup_samples=0,
+                                  autotune_steps_per_sample=1)
+    at = Autotuner(st)
+    at.record_cycle(1000, 0.001)  # sample #1 -> propose -> _apply
+    assert at._settle_left == _SETTLE_CYCLES
+    n = len(at._samples_y)
+    # The settle window: a compile-stalled outlier cycle must vanish
+    # without being accumulated or recorded as a sample.
+    for _ in range(_SETTLE_CYCLES):
+        at.record_cycle(10 ** 12, 5.0)
+    assert len(at._samples_y) == n
+    assert at._settle_left == 0
+    assert at._acc_cycles == 0 and at._acc_bytes == 0
+    # Scoring resumes on the next cycle, clean of the stall.
+    at.record_cycle(1000, 0.001)
+    assert len(at._samples_y) == n + 1
+    assert max(at._samples_y) == pytest.approx(1000 / 0.001)
+    # Zero-payload cycles never consume the settle window (an idle cycle
+    # compiles nothing, so it proves nothing about warmth).
+    at._settle_left = _SETTLE_CYCLES
+    at.record_cycle(0, 0.001)
+    assert at._settle_left == _SETTLE_CYCLES
+
+
+def test_autotuner_pins_compiled_sched_when_distributed():
+    """Compiled default + multi-process engine: the schedule dimension
+    pins to the compiled descriptor (same rank-divergence rule as the
+    decomposed pin below)."""
+    from horovod_tpu.utils.autotune import Autotuner
+
+    class FakeEngine:
+        distributed = True
+
+    class FakeState:
+        pass
+
+    from horovod_tpu import config as config_mod
+    st = FakeState()
+    st.engine = FakeEngine()
+    st.config = config_mod.Config(
+        autotune=True, autotune_warmup_samples=0,
+        autotune_steps_per_sample=1, sched_mode="compiled", sched_chunks=2)
+    at = Autotuner(st)
+    assert at._scheds == ["compiled:rs_ag:2"]
+    assert {g[3] for g in at._grid_raw} == {"compiled:rs_ag:2"}
 
 
 def test_autotuner_pins_sched_and_mode_when_distributed():

@@ -90,6 +90,31 @@ def test_update_decomposed_schedule_parity():
     assert np.array_equal(got, base)
 
 
+def test_update_compiled_schedule_single_program():
+    """sched_mode=compiled: the optax train step is ONE jitted program —
+    updates bit-identical to the monolithic psum path AND the engine's
+    per-chunk schedule dispatch counter never moves (inside jit the
+    whole step already is one executable; this is the invariant the CI
+    compiled-parity job's zero-dispatch guard pins at np=2/4)."""
+    from horovod_tpu.ops.sched.executor import _m_sched
+    cfg = hvd.global_state().config
+    params = {"w": jnp.zeros((3000,), jnp.float32)}
+    grads = hvd.per_rank(
+        [np.random.RandomState(40 + r).randn(3000).astype(np.float32)
+         for r in range(N)])
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0))
+    base = hvd.to_numpy(_mapped_update(tx, {"w": grads}, params)["w"])
+    old = (cfg.sched_mode, cfg.sched_chunks)
+    before = _m_sched.total()
+    cfg.sched_mode, cfg.sched_chunks = "compiled", 3
+    try:
+        got = hvd.to_numpy(_mapped_update(tx, {"w": grads}, params)["w"])
+    finally:
+        cfg.sched_mode, cfg.sched_chunks = old
+    assert np.array_equal(got, base)
+    assert _m_sched.total() == before
+
+
 def test_update_decomposed_quant_within_bound():
     """Decomposed + int8 wire: the update stays inside the documented
     shared-scale quantization bound of the exact mean (the decomposed

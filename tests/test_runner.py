@@ -161,6 +161,28 @@ def test_hvdrun_decomposed_allreduce_parity(np_):
 
 
 @pytest.mark.integration
+@pytest.mark.parametrize("np_", [2, 4])
+def test_hvdrun_compiled_allreduce_parity(np_):
+    """Compiled single-program (ops/sched/compiled) vs monolithic
+    allreduce over real negotiated transport (the ci.yaml
+    compiled-parity job): BIT-exact for int8/fp8 at both sizes,
+    BIT-exact for fp32 at np=2 and <=2-ulp at np=4, with the engine's
+    per-chunk dispatch counter pinned at ZERO for the whole battery
+    (one cached jitted program per fused group), a mixed-mode phase
+    where a decomposed-pinned rank adopts the coordinator's echoed
+    compiled descriptor before fusion (divergent backends deadlock on
+    per-executable channel IDs, so completion is part of the
+    assertion), and the join/rebuild path with a compiled ``sc``
+    descriptor."""
+    res = _hvdrun(np_, [os.path.join(REPO, "tests", "mp_sched_worker.py")],
+                  timeout=120 + 30 * np_,
+                  extra_env={"HVDTPU_TEST_MODE": "compiled"})
+    assert res.returncode == 0, res.stdout + res.stderr
+    for r in range(np_):
+        assert f"rank {r}: COMPILED-OK" in res.stdout, res.stdout
+
+
+@pytest.mark.integration
 def test_hvdrun_hierarchical_parity():
     """Chunked+tiered (``hier:2:2``) vs flat allreduce over real
     negotiated transport at np=4 as a 2x2 tier mesh (the ci.yaml

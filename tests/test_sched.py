@@ -149,6 +149,18 @@ def test_parse_descriptor():
     assert sched.descriptor(2) == "rs_ag:2"
 
 
+def test_parse_compiled_descriptor():
+    assert sched.parse_compiled_descriptor("compiled:rs_ag:4") == 4
+    assert sched.parse_compiled_descriptor("compiled:rs_ag:0") is None
+    assert sched.parse_compiled_descriptor("rs_ag:4") is None
+    assert sched.parse_compiled_descriptor("compiled:hier:2:2") is None
+    assert sched.parse_compiled_descriptor("") is None
+    assert sched.compiled_descriptor(2) == "compiled:rs_ag:2"
+    assert sched.known_descriptor("compiled:rs_ag:2")
+    # The dispatched parser must NOT claim compiled descriptors.
+    assert sched.parse_descriptor("compiled:rs_ag:4") is None
+
+
 def test_resolve_schedule_gates(sched_cfg):
     from horovod_tpu.ops.collectives import ReduceOp
     cfg = sched_cfg
@@ -211,6 +223,52 @@ def test_resolve_schedule_gates(sched_cfg):
     # Default config: monolithic.
     cfg.sched_mode = "monolithic"
     assert res() == ""
+
+
+def test_resolve_schedule_compiled(sched_cfg):
+    """The compiled mode shares every eligibility gate with decomposed
+    (same chunk_layout, same verb/op/dtype/size rules) and differs only
+    in the descriptor family it emits — except under a hierarchical
+    split, where it deterministically falls back to the DISPATCHED
+    ``hier:*`` family (no compiled tiered lowering yet; ISSUE 16)."""
+    from horovod_tpu.ops.collectives import ReduceOp
+    cfg = sched_cfg
+    cfg.sched_mode, cfg.sched_chunks = "compiled", 4
+    ok = dict(verb="allreduce", op=ReduceOp.AVERAGE, dtype=np.float32,
+              nbytes=1 << 20, cfg=cfg, n=8, mode="fp32")
+
+    def res(**kw):
+        a = {**ok, **kw}
+        return sched.resolve_schedule(a.pop("requested", ""), a["verb"],
+                                      a["op"], a["dtype"], a["nbytes"],
+                                      a["cfg"], a["n"], a["mode"])
+    assert res() == "compiled:rs_ag:4"
+    assert res(requested="compiled") == "compiled:rs_ag:4"
+    assert res(requested="compiled:rs_ag:2") == "compiled:rs_ag:2"
+    # Explicit requests for the other backends still win per call.
+    assert res(requested="monolithic") == ""
+    assert res(requested="rs_ag:2") == "rs_ag:2"
+    # Identical gates to decomposed.
+    assert res(verb="allgather") == ""
+    assert res(op=ReduceOp.MAX) == ""
+    assert res(dtype=np.int32) == ""
+    assert res(n=1) == ""
+    assert res(nbytes=16) == ""
+    assert res(mode="bf16") == ""
+    assert res(mode="fp16") == ""
+    # Hierarchical split: deterministic fallback to the dispatched
+    # chunked+tiered family at the SAME chunk count (logged once).
+    cfg.hierarchical_allreduce = True
+    old_ls = cfg.hierarchical_local_size
+    try:
+        cfg.hierarchical_local_size = 4
+        assert res() == "hier:4:4"
+        assert res(requested="compiled:rs_ag:2") == "hier:4:2"
+    finally:
+        cfg.hierarchical_allreduce = False
+        cfg.hierarchical_local_size = old_ls
+    # Without the split the compiled family survives the flag.
+    assert res() == "compiled:rs_ag:4"
 
 
 # ---------------------------------------------------------------------------
@@ -302,6 +360,128 @@ def test_overlap_fraction_math():
                              [(2, 4), (2, 4)]) == pytest.approx(0.2)
     assert _overlap_fraction([(0, 10)],
                              [(2, 5), (3, 6)]) == pytest.approx(0.4)
+
+
+# ---------------------------------------------------------------------------
+# Compiled single-program backend (ops/sched/compiled)
+# ---------------------------------------------------------------------------
+
+def test_compiled_bit_exact_fp32(sched_cfg):
+    """One jitted GSPMD program == monolithic psum, bit for bit: the
+    compiled kernel inlines the executor's fp32 phase builders, and on
+    this backend psum and psum_scatter+all_gather share per-element
+    float-op association (the same property the decomposed test pins)."""
+    parts = _parts(5000, seed=21)
+    x = hvd.per_rank(parts)
+    ref = hvd.to_numpy(hvd.allreduce(x, hvd.Average))
+    sched_cfg.sched_mode, sched_cfg.sched_chunks = "compiled", 4
+    got = hvd.to_numpy(hvd.allreduce(x, hvd.Average))
+    assert np.array_equal(ref, got)          # BIT-exact, not allclose
+    sched_cfg.sched_mode = "monolithic"
+    ref_s = hvd.to_numpy(hvd.allreduce(x, hvd.Sum))
+    sched_cfg.sched_mode = "compiled"
+    got_s = hvd.to_numpy(hvd.allreduce(x, hvd.Sum))
+    assert np.array_equal(ref_s, got_s)
+    # And against the dispatched decomposition at the same chunk count.
+    sched_cfg.sched_mode = "decomposed"
+    deco = hvd.to_numpy(hvd.allreduce(x, hvd.Average))
+    assert np.array_equal(got, deco)
+
+
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_compiled_bit_exact_quantized(sched_cfg, mode):
+    """Quantized compiled program == monolithic quantized kernel, bit
+    for bit: identical n*block chunk boundaries, shared-pmax scales,
+    exact narrow-accumulator psum_scatter, local requantization."""
+    sched_cfg.quant_min_bytes = 0
+    parts = _parts(100000, seed=23)
+    x = hvd.per_rank(parts)
+    sched_cfg.sched_mode = "monolithic"
+    ref = hvd.to_numpy(hvd.allreduce(x, hvd.Average, compression=mode))
+    sched_cfg.sched_mode, sched_cfg.sched_chunks = "compiled", 3
+    got = hvd.to_numpy(hvd.allreduce(x, hvd.Average, compression=mode))
+    assert np.array_equal(ref, got)
+    # The quantized path really ran (lossy vs exact numpy).
+    exact = np.stack(parts).mean(0)
+    assert np.abs(got - exact).max() > 0
+
+
+def test_compiled_grouped_and_prepost_scale(sched_cfg):
+    sched_cfg.sched_mode, sched_cfg.sched_chunks = "compiled", 2
+    xs = [hvd.per_rank([np.full((97,), float(r + i), np.float32)
+                        for r in range(N)]) for i in range(3)]
+    outs = hvd.grouped_allreduce(xs, hvd.Sum)
+    for i, o in enumerate(outs):
+        np.testing.assert_array_equal(
+            hvd.to_numpy(o), np.full((97,), sum(range(N)) + N * i))
+    from horovod_tpu.ops import collectives as C
+    x = hvd.per_rank(_parts(4096, seed=25))
+    sched_cfg.sched_mode = "monolithic"
+    ref = hvd.to_numpy(C.allreduce(x, hvd.Sum, prescale_factor=0.5,
+                                   postscale_factor=2.0))
+    sched_cfg.sched_mode = "compiled"
+    got = hvd.to_numpy(C.allreduce(x, hvd.Sum, prescale_factor=0.5,
+                                   postscale_factor=2.0))
+    assert np.array_equal(ref, got)
+
+
+def test_compiled_counters_and_program_cache(sched_cfg):
+    """The contract the CI compiled-parity job asserts at np>1: the
+    compiled path takes ONE program dispatch (its own counter moves) and
+    ZERO per-chunk executor dispatches; re-dispatching the same schedule
+    signature is a cache hit, not a rebuild."""
+    from horovod_tpu.ops import collectives as C
+    from horovod_tpu.ops.sched.compiled import _m_compiled
+    from horovod_tpu.ops.sched.executor import _m_sched
+    sched_cfg.sched_mode, sched_cfg.sched_chunks = "compiled", 4
+    x = hvd.per_rank(_parts(8192, seed=27))
+    before_c = _m_compiled.labels(schedule="compiled:rs_ag:4").value
+    before_s = _m_sched.total()
+    out1 = hvd.to_numpy(hvd.allreduce(x, hvd.Average))
+    assert _m_compiled.labels(
+        schedule="compiled:rs_ag:4").value == before_c + 1
+    assert _m_sched.total() == before_s      # zero per-chunk dispatches
+    # Same signature again: program-cache hit, no new build.
+    hits0, miss0 = C._cache.hits, C._cache.misses
+    out2 = hvd.to_numpy(hvd.allreduce(x, hvd.Average))
+    assert C._cache.misses == miss0
+    assert C._cache.hits > hits0
+    assert np.array_equal(out1, out2)
+    assert _m_sched.total() == before_s
+
+
+def test_compiled_executor_routes_descriptor(sched_cfg):
+    """executor.execute_allreduce hands compiled descriptors to the
+    compiled backend — the engine's single dispatch call site never
+    branches on the family itself."""
+    from horovod_tpu.ops.sched import executor as SE
+    x = hvd.per_rank(_parts(4096, seed=29))
+    ref = hvd.to_numpy(hvd.allreduce(x, hvd.Average))
+    out = SE.execute_allreduce([x], hvd.Average,
+                               descriptor="compiled:rs_ag:2")
+    assert np.array_equal(ref, hvd.to_numpy(out[0]))
+
+
+def test_compiled_rejects_cast_modes_and_unknown_descriptors():
+    from horovod_tpu.ops.sched import compiled as CP
+    x = hvd.per_rank([np.ones((64,), np.float32)] * N)
+    with pytest.raises(ValueError, match="cast wire mode"):
+        CP.execute_allreduce([x], hvd.Sum, descriptor="compiled:rs_ag:2",
+                             precision="bf16")
+    with pytest.raises(ValueError, match="unknown compiled"):
+        CP.execute_allreduce([x], hvd.Sum, descriptor="rs_ag:2")
+
+
+def test_perfmodel_compiled_expectation():
+    """The compiled arm keeps the ring's wire bytes but collapses the
+    per-chunk dispatch latency: steps == one ring regardless of k."""
+    from horovod_tpu.obs import perfmodel as PM
+    c = PM.expected_allreduce(1 << 20, 8, chunks=4, compiled=True)
+    d = PM.expected_allreduce(1 << 20, 8, chunks=4)
+    assert c.schedule == "compiled:rs_ag:4"
+    assert d.schedule == "rs_ag:4"
+    assert c.wire_bytes == d.wire_bytes
+    assert c.steps == 2 * 7 and d.steps == 2 * 7 * 4
 
 
 # ---------------------------------------------------------------------------
@@ -437,6 +617,76 @@ def test_fusion_splits_mixed_schedules(sched_cfg):
     assert keyed == [("",), ("rs_ag:2",), ("rs_ag:4", "rs_ag:4")]
 
 
+def test_entry_meta_carries_compiled_schedule(sched_cfg):
+    """The compiled backend choice rides the SAME ``sc`` negotiation
+    field as the dispatched descriptors (wp-style contract): peers
+    joining mid-run and version-skewed peers see one vocabulary."""
+    from horovod_tpu.ops.engine import (TensorTableEntry,
+                                        _parse_joinable_meta)
+    x = hvd.per_rank([np.ones((4096,), np.float32)] * N)
+    e = TensorTableEntry(name="t.csc", verb="allreduce", payload=x,
+                         op=hvd.Sum, schedule="compiled:rs_ag:4")
+    m = json.loads(e.meta())
+    assert m["sc"] == "compiled:rs_ag:4"
+    parsed = _parse_joinable_meta(e.meta())
+    assert parsed is not None and parsed["sc"] == "compiled:rs_ag:4"
+
+
+def test_fusion_splits_compiled_from_dispatched(sched_cfg):
+    """Compiled and dispatched entries must never fuse: their payloads
+    run through different executables."""
+    from horovod_tpu.ops.engine import TensorTableEntry
+    eng = hvd.global_state().engine
+    x = hvd.per_rank([np.ones((64,), np.float32)] * N)
+    entries = [
+        TensorTableEntry(name=f"t.cf.{i}", verb="allreduce", payload=x,
+                         op=hvd.Sum, schedule=s)
+        for i, s in enumerate(
+            ["compiled:rs_ag:4", "rs_ag:4", "compiled:rs_ag:4", ""])]
+    groups = eng._fuse(entries)
+    keyed = sorted(tuple(e.schedule for e in g) for g in groups)
+    assert keyed == [("",), ("compiled:rs_ag:4", "compiled:rs_ag:4"),
+                     ("rs_ag:4",)]
+
+
+def test_reconcile_metas_adopts_echoed_common_mode(sched_cfg):
+    """Mixed-mode peers: the coordinator echoes the lowest rank's meta
+    and every rank adopts its schedule/wire fields BEFORE fusion, so all
+    processes execute the same program (collective channel IDs are
+    per-executable under jax.distributed — a rank running the compiled
+    program against peers walking per-chunk dispatches deadlocks)."""
+    from horovod_tpu.ops.engine import TensorTableEntry
+    eng = hvd.global_state().engine
+    x = hvd.per_rank([np.ones((4096,), np.float32)] * N)
+    e = TensorTableEntry(name="t.rm", verb="allreduce", payload=x,
+                         op=hvd.Sum, schedule="compiled:rs_ag:4")
+    peer = TensorTableEntry(name="t.rm", verb="allreduce", payload=x,
+                            op=hvd.Sum, schedule="rs_ag:4",
+                            precision="int8")
+    eng._reconcile_metas([e], {"t.rm": e}, {"t.rm": peer.meta()})
+    assert e.schedule == "rs_ag:4"
+    assert e.precision == "int8"
+    # Echo of our own meta: no-op.
+    e2 = TensorTableEntry(name="t.rm2", verb="allreduce", payload=x,
+                          op=hvd.Sum, schedule="compiled:rs_ag:4")
+    eng._reconcile_metas([e2], {"t.rm2": e2}, {"t.rm2": e2.meta()})
+    assert e2.schedule == "compiled:rs_ag:4"
+    # Unparseable meta from a version-skewed peer: skip, don't adopt.
+    bad = json.loads(peer.meta())
+    bad["sc"] = "ring_exchange:9"
+    e3 = TensorTableEntry(name="t.rm3", verb="allreduce", payload=x,
+                          op=hvd.Sum, schedule="compiled:rs_ag:4")
+    eng._reconcile_metas([e3], {"t.rm3": e3}, {"t.rm3": json.dumps(bad)})
+    assert e3.schedule == "compiled:rs_ag:4"
+    # The adopted direction also runs dispatched -> compiled.
+    e4 = TensorTableEntry(name="t.rm4", verb="allreduce", payload=x,
+                          op=hvd.Sum, schedule="rs_ag:4")
+    peer4 = TensorTableEntry(name="t.rm4", verb="allreduce", payload=x,
+                             op=hvd.Sum, schedule="compiled:rs_ag:4")
+    eng._reconcile_metas([e4], {"t.rm4": e4}, {"t.rm4": peer4.meta()})
+    assert e4.schedule == "compiled:rs_ag:4"
+
+
 def test_zero_entry_rebuilds_schedule(sched_cfg):
     """A joined rank must rebuild entries at the SAME schedule (and
     precision) the live ranks resolved, or the per-chunk dispatches
@@ -448,3 +698,7 @@ def test_zero_entry_rebuilds_schedule(sched_cfg):
     e = eng._zero_entry("t.zj", _parse_joinable_meta(json.dumps(meta)))
     assert e.schedule == "rs_ag:4"
     assert e.precision == ""
+    # Compiled descriptors rebuild identically.
+    meta["sc"] = "compiled:rs_ag:4"
+    e2 = eng._zero_entry("t.zjc", _parse_joinable_meta(json.dumps(meta)))
+    assert e2.schedule == "compiled:rs_ag:4"
