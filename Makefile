@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: ci native test mp-test examples bench baseline-table image \
 	autoscale-recovery perf-regress bench-trajectory hierarchical-parity \
-	compiled-parity
+	compiled-parity zero1-parity
 
 # The autoscale-recovery CI job standalone: np=4 MoE job, injected rank
 # death + SLO load spike => shrink to np=2, grow back to np=4.
@@ -32,6 +32,13 @@ horovod_tpu.serving"
 # guard, mixed-mode meta reconciliation, fusion split, join/rebuild.
 compiled-parity:
 	$(PY) -m pytest "tests/test_runner.py::test_hvdrun_compiled_allreduce_parity" -q
+
+# The zero1-parity CI job standalone: np=2 and np=4, the ZeRO-1 sharded
+# step (rs -> 1/n update -> param allgather) vs the dense allreduce
+# step, bucketed-vs-unbucketed eager parity (fp32 + int8), the compiled
+# zero-dispatch guard, and join/rebuild through the bucketed path.
+zero1-parity:
+	$(PY) -m pytest "tests/test_runner.py::test_hvdrun_zero1_parity" -q
 
 # The hierarchical-parity CI job standalone: np=4 as a 2x2 two-tier
 # rig, chunked+tiered hier:2:2 schedule vs flat parity, quantized cross

@@ -55,8 +55,10 @@ ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 MEASURED = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "measured.jsonl")
 
-#: substrings that mark a metric as lower-is-better (latencies, times).
-_LOWER_BETTER = ("_ms", "_us", "ttft", "itl", "_seconds", "latency")
+#: substrings that mark a metric as lower-is-better (latencies, times,
+#: byte footprints — a growing ``*_bytes`` series is a memory regression).
+_LOWER_BETTER = ("_ms", "_us", "ttft", "itl", "_seconds", "latency",
+                 "_bytes")
 
 
 def _higher_is_better(metric: str) -> bool:
@@ -322,6 +324,24 @@ def _extract_bench_file(path: str) -> list:
                         round_id, order, "alltoall_busbw_peak_GBs",
                         pk["busbw_GBs"], unit="GB/s",
                         device_kind=f"cpu-rig-{npname}", source=name))
+    # r12 train-step section (train_bench.py): dense-vs-ZeRO-1 rows
+    # already in the measured-record shape; step_ms and opt_state_bytes
+    # both auto-resolve to lower-is-better.
+    ts = d.get("trainstep")
+    if isinstance(ts, list):
+        for ent in ts:
+            if not isinstance(ent, dict):
+                continue
+            mt, val = ent.get("metric"), ent.get("value")
+            if not mt or not isinstance(val, (int, float)):
+                continue
+            kind = ent.get("device_kind") or (
+                f"cpu-rig-np{int(ent['ranks'])}"
+                if isinstance(ent.get("ranks"), (int, float))
+                else "unspecified")
+            rows.append(_row(round_id, order, mt, val,
+                             unit=ent.get("unit", ""),
+                             device_kind=kind, source=name))
     return [r for r in rows if r]
 
 

@@ -832,6 +832,14 @@ def __getattr__(name: str):
                 "distributed_gradients"):
         from .optim import distributed
         return getattr(distributed, name)
+    if name == "ZeroDistributedOptimizer":
+        # ZeRO-1 sharded optimizer: rs chain stops at the shard, inner
+        # optax state lives on the 1/n slice, one param allgather/step.
+        from .optim import zero
+        return zero.ZeroDistributedOptimizer
+    if name == "bucketed_distributed_gradients":
+        from .ops.sched import buckets
+        return buckets.bucketed_distributed_gradients
     if name == "elastic":
         import importlib
         return importlib.import_module("horovod_tpu.elastic")

@@ -118,6 +118,22 @@ class Config:
     # into >= 2 chunks fall back to monolithic per resolve_schedule).
     sched_chunks: int = 4
 
+    # --- ZeRO-1 sharded optimizer + bucket overlap (optim/zero.py,
+    # ops/sched/buckets.py) ---
+    # When set, optim.zero.from_config wraps the inner optax
+    # transformation as the ZeRO-1 sharded optimizer (optimizer state
+    # 1/n per rank, one parameter allgather per step) instead of the
+    # dense DistributedOptimizer.  The wrapper itself is always
+    # available regardless of this knob.
+    zero: bool = False
+    # Size target in bytes for gradient fusion buckets (the Horovod
+    # fusion-buffer analogue): caps the per-bucket payload of the
+    # bucketed eager path and the in-jit bucket boundaries, and caps the
+    # engine's fusion groups below fusion_threshold.  <= 0 means
+    # unbounded buckets (one per dtype/wire-mode group) and leaves the
+    # engine's fusion_threshold as the only cap.
+    bucket_bytes: int = 0
+
     # --- response/dispatch cache († response_cache.cc) ---
     # Capacity of the compiled-collective dispatch cache (signature -> jitted
     # program).  The XLA-compile cache plays the role of the reference's
@@ -280,6 +296,8 @@ _ENV_TABLE = [
     ("quant_min_bytes", "QUANT_MIN_BYTES", int),
     ("sched_mode", "SCHED_MODE", _parse_sched_mode),
     ("sched_chunks", "SCHED_CHUNKS", int),
+    ("zero", "ZERO", _parse_bool),
+    ("bucket_bytes", "BUCKET_BYTES", int),
     ("cache_capacity", "CACHE_CAPACITY", int),
     ("autotune", "AUTOTUNE", _parse_bool),
     ("autotune_log", "AUTOTUNE_LOG", str),

@@ -191,6 +191,55 @@ def expected_collective(verb: str, payload_bytes: int, n: int, *,
                         steps=steps, busbw_factor=busbw_factor(verb, n))
 
 
+def expected_zero_step(payload_bytes: int, n: int, *, mode: str = "fp32",
+                       chunks: int = 1, block: int = 512,
+                       itemsize: int = 4, param_bytes: Optional[int] = None,
+                       compiled: bool = False) -> ExpectedCost:
+    """ZeRO-1 sharded-optimizer step (optim/zero.py): the gradient rides
+    ONLY the reduce-scatter half of the rs_ag chain (no gradient
+    allgather — the shard stays local for the sharded update), and one
+    *parameter* allgather closes the step.
+
+    Wire accounting per device: rs moves ``(n-1)/n`` of the gradient at
+    half the allreduce per-element width (the rs half of
+    :func:`wire_per_elem`); the parameter allgather moves ``(n-1)/n`` of
+    ``param_bytes`` raw (parameters never quantize — the update must be
+    bit-exact across ranks).  For fp32 with ``param_bytes ==
+    payload_bytes`` this sums to exactly the dense allreduce wire — the
+    ZeRO-1 claim: optimizer memory /n at identical wire bytes.  Under a
+    quant wire mode only the rs half keeps the narrow width; the raw
+    parameter allgather costs more than dense's quantized allgather
+    half, so quant ZeRO trades some wire for the exactness of the
+    parameter broadcast — the model makes that visible rather than
+    hiding it.  Steps: ``(n-1)`` per rs chunk plus
+    one allgather ring; ``compiled=True`` collapses the per-chunk
+    dispatch latency the same way :func:`expected_allreduce` does.
+    """
+    if n < 1 or payload_bytes < 0:
+        raise ValueError(f"bad inputs n={n} bytes={payload_bytes}")
+    mode = mode or "fp32"
+    pbytes = payload_bytes if param_bytes is None else param_bytes
+    numel = payload_bytes / max(1, itemsize)
+    frac = (n - 1) / n if n > 1 else 0.0
+    rs_wire = frac * (wire_per_elem(mode, itemsize, block) / 2.0) * numel
+    ag_wire = frac * float(pbytes)
+    k = max(1, int(chunks))
+    if compiled:
+        steps = 2 * (n - 1) if n > 1 else 0
+        sched = f"zero1:compiled:rs_ag:{k}"
+    else:
+        steps = ((n - 1) * k + (n - 1)) if n > 1 else 0
+        sched = f"zero1:rs_ag:{k}"
+    return ExpectedCost(verb="zero_step", mode=mode, schedule=sched,
+                        n=n, payload_bytes=payload_bytes,
+                        wire_bytes=rs_wire + ag_wire, steps=steps,
+                        busbw_factor=busbw_factor("allreduce", n),
+                        tiers={"rs": TierCost(rs_wire,
+                                              (n - 1) * k if n > 1 else 0),
+                               "param_ag": TierCost(ag_wire,
+                                                    n - 1 if n > 1 else 0)})
+
+
 def expected_hierarchical(payload_bytes: int, n_local: int, n_cross: int,
                           *, itemsize: int = 4, mode: str = "fp32",
                           cross_mode: str = "", chunks: int = 1,

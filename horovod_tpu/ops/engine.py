@@ -778,6 +778,13 @@ class CollectiveEngine:
         (matching the reference — other verbs execute per-tensor).
         """
         threshold = self._state.config.fusion_threshold
+        # HOROVOD_TPU_BUCKET_BYTES: the sched bucket layer's size target
+        # also caps fused groups, so a bucketed backward's per-bucket
+        # dispatches are not re-coalesced into one giant buffer that
+        # would serialize the overlap the buckets exist to create.
+        bucket = int(getattr(self._state.config, "bucket_bytes", 0) or 0)
+        if bucket > 0:
+            threshold = min(threshold, bucket)
         groups: dict[tuple, list[TensorTableEntry]] = {}
         order: list[tuple] = []
         singles: list[list[TensorTableEntry]] = []

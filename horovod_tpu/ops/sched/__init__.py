@@ -48,6 +48,7 @@ from .lower import (  # noqa: F401
 from .in_context import (  # noqa: F401
     matmul_reducescatter,
     overlap_allreduce,
+    overlap_reducescatter,
     run_in_context,
 )
 

@@ -1,0 +1,168 @@
+"""Bucketed backward overlap: the Horovod fusion-buffer analogue.
+
+Horovod's fusion buffer batches small gradients into one collective and
+dispatches it while the rest of backward still runs.  Here the same idea
+appears twice, sized by ``HOROVOD_TPU_BUCKET_BYTES`` (``cfg.bucket_bytes``;
+<= 0 means one bucket per dtype group):
+
+- **Eager/engine path** (:func:`bucketed_distributed_gradients`): the
+  gradient pytree is grouped into size-targeted buckets; each bucket's
+  leaves enqueue on the async engine and the engine is *nudged*
+  immediately, so bucket *b*'s (decomposed) reduce-scatter dispatches
+  while bucket *b+1* is still being enqueued — comm hides under the
+  remaining host work, and the executor's
+  ``hvd_sched_overlap_fraction`` gauge shows the realized overlap.
+  The entries are ordinary engine entries, so they ride negotiation
+  meta (``sc``/``wp``) for join/rebuild exactly like the dense path.
+
+- **In-jit path** (:func:`attach_gradient_reduction`): each bucket
+  becomes a ``custom_vjp`` boundary around its parameters — identity on
+  the forward; on the backward, the bucket's cotangents are reduced
+  through one :func:`~.in_context.overlap_allreduce` chain as soon as
+  backward produces them.  Each bucket is an independent rs/ag chain in
+  the graph, so XLA's latency-hiding scheduler overlaps chain *b*'s
+  collective with chain *b+1*'s backward arithmetic (chain-by-chain,
+  instead of one barrier after the whole backward).
+
+The ZeRO-1 optimizer (:mod:`optim.zero`) rides the same bucket grammar
+via :mod:`optim.partition` (shared padding/chunk-unit rules), stopping
+each bucket's chain at the shard.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _resolved_bucket_bytes(bucket_bytes: Optional[int]) -> int:
+    if bucket_bytes is not None:
+        return int(bucket_bytes)
+    from ...context import global_state
+    from ... import config as config_mod
+    state = global_state()
+    cfg = state.config if state.initialized else config_mod.Config()
+    return int(getattr(cfg, "bucket_bytes", 0) or 0)
+
+
+def plan_buckets(leaves: Sequence[Any],
+                 bucket_bytes: Optional[int] = None) -> list:
+    """Group leaf *indices* into size-targeted buckets.
+
+    Greedy in pytree order — the order backward produces gradients —
+    never mixing dtypes (a fused buffer must share one wire layout).  A
+    bucket closes when the next same-dtype leaf would push it past the
+    byte target; one oversized leaf still gets its own bucket.  Returns
+    ``[[leaf_index, ...], ...]``.
+    """
+    target = _resolved_bucket_bytes(bucket_bytes)
+    open_by_dtype: dict = {}
+    order: list = []
+    for i, leaf in enumerate(leaves):
+        arr = jnp.asarray(leaf)
+        nbytes = int(arr.size) * arr.dtype.itemsize
+        key = str(arr.dtype)
+        cur = open_by_dtype.get(key)
+        if cur is not None and target > 0 and \
+                cur["bytes"] + nbytes > target:
+            cur = None
+        if cur is None:
+            cur = {"idx": [], "bytes": 0}
+            open_by_dtype[key] = cur
+            order.append(cur)
+        cur["idx"].append(i)
+        cur["bytes"] += nbytes
+    return [b["idx"] for b in order]
+
+
+def bucketed_distributed_gradients(per_rank_grads: Any,
+                                   op=None, *,
+                                   compression=None,
+                                   process_set=None,
+                                   bucket_bytes: Optional[int] = None
+                                   ) -> Any:
+    """Eager bucket-by-bucket reduction of a per-rank gradient pytree.
+
+    The bucketed twin of :func:`optim.distributed.distributed_gradients`:
+    identical results (same engine entries, same fusion/negotiation/
+    wire-mode rules), but each bucket's enqueue is followed by an engine
+    nudge so its collective dispatches while later buckets are still
+    being prepared — per-bucket dispatch as leaves become available,
+    instead of one enqueue-everything barrier.
+    """
+    import horovod_tpu as hvd
+    from ..compression import Compression, routes_engine_side
+    if op is None:
+        op = hvd.Average
+    if compression is None:
+        compression = Compression.none
+    leaves, treedef = jax.tree.flatten(per_rank_grads)
+    buckets = plan_buckets(leaves, bucket_bytes)
+    kw = {"compression": compression} if routes_engine_side(compression) \
+        else {}
+    engine = getattr(hvd.global_state(), "engine", None)
+    handles = [None] * len(leaves)
+    ctxs = [None] * len(leaves)
+    for bucket in buckets:
+        for i in bucket:
+            if kw:
+                wire, ctxs[i] = jnp.asarray(leaves[i]), None
+            else:
+                wire, ctxs[i] = compression.compress(
+                    jnp.asarray(leaves[i]))
+            handles[i] = hvd.allreduce_async(
+                wire, op, process_set=process_set, **kw)
+        # Per-bucket dispatch: wake the cycle thread now instead of
+        # waiting out cycle_time_ms — bucket b's collective negotiates/
+        # dispatches while bucket b+1 enqueues.
+        if engine is not None:
+            engine.nudge()
+    reduced = [h.wait() if kw else compression.decompress(h.wait(), ctx)
+               for h, ctx in zip(handles, ctxs)]
+    return jax.tree.unflatten(treedef, reduced)
+
+
+def attach_gradient_reduction(params: Any, axis_name: str = "hvd", *,
+                              average: bool = True, mode: str = "fp32",
+                              chunks: int = 2, block: int = 512,
+                              bucket_bytes: Optional[int] = None) -> Any:
+    """In-jit bucket boundaries: identity on ``params``, but gradients
+    flowing back through the result are cross-replica reduced per bucket
+    via :func:`~.in_context.overlap_allreduce` chains.
+
+    ``jax.grad`` of a loss taken through the returned tree yields
+    already-reduced gradients, bucket by bucket, as backward emits each
+    bucket's cotangent — each bucket is its own ``custom_vjp`` boundary
+    wrapping one rs/ag chain, so XLA can overlap chain *b*'s collective
+    with chain *b+1*'s backward compute.  Values (and the forward graph)
+    are untouched.
+    """
+    leaves, treedef = jax.tree.flatten(params)
+    buckets = plan_buckets(leaves, bucket_bytes)
+
+    def _reduce_ct(ct):
+        from .in_context import overlap_allreduce
+        return overlap_allreduce(jnp.asarray(ct), axis_name,
+                                 average=average, mode=mode,
+                                 chunks=chunks, block=block)
+
+    @jax.custom_vjp
+    def _boundary(*bucket_leaves):
+        return bucket_leaves
+
+    def _fwd(*bucket_leaves):
+        return bucket_leaves, None
+
+    def _bwd(_, cts):
+        return tuple(_reduce_ct(ct) for ct in cts)
+
+    _boundary.defvjp(_fwd, _bwd)
+
+    out = list(leaves)
+    for bucket in buckets:
+        wrapped = _boundary(*(leaves[i] for i in bucket))
+        for j, i in enumerate(bucket):
+            out[i] = wrapped[j]
+    return jax.tree.unflatten(treedef, out)

@@ -98,6 +98,66 @@ def overlap_allreduce(x: jax.Array, axis_name: str, *, average: bool = True,
     return out.reshape(x.shape).astype(out_dtype)
 
 
+def overlap_reducescatter(flat: jax.Array, axis_name: str, *,
+                          layout, average: bool = True,
+                          mode: str = "fp32",
+                          block: int = 512) -> jax.Array:
+    """The :func:`overlap_allreduce` chain STOPPED at the shard — the
+    ZeRO-1 half: per chunk ``[encode] -> psum_scatter -> combine`` with
+    **no** gradient allgather; the caller closes the step with one
+    *parameter* allgather instead (:mod:`optim.zero`).
+
+    ``flat`` must already be padded to ``sum(layout)`` (fp32 for the
+    quant modes, matching ``overlap_allreduce``'s internal cast); each
+    ``layout`` entry must divide by the axis size (and by ``n * block``
+    for quant modes) — :func:`~.lower.chunk_layout` guarantees both.
+    Returns the rank's ``sum(layout)/n`` shard in chunk-major order.
+
+    Numerics are bit-identical to the corresponding elements of
+    ``overlap_allreduce``'s output: the quant path re-applies the same
+    post-combine requantization roundtrip the dense chain wires through
+    its allgather, so a ZeRO step and a dense step see the exact same
+    reduced-gradient bits for every element of the shard.
+    """
+    n = axis_size(axis_name)
+    if n <= 1:
+        return flat
+    alg = R.algebra_for(mode)
+    quant = mode in R.QUANT_MODES
+    outs = []
+    off = 0
+    for clen in layout:
+        ch = lax.dynamic_slice_in_dim(flat, off, clen)
+        off += clen
+        if quant:
+            blocks = ch.reshape(clen // block, block)
+            shared = alg.scale_from_absmax(
+                lax.pmax(alg.block_absmax(blocks), axis_name))
+            q, _ = alg.wire_encode(blocks, shared_scale=shared)
+            acc = lax.psum_scatter(
+                q.astype(alg.acc_dtype).reshape(-1), axis_name,
+                scatter_dimension=0, tiled=True)
+            sblocks = (clen // block) // n
+            me = lax.axis_index(axis_name)
+            my_scale = lax.dynamic_slice_in_dim(
+                shared, me * sblocks, sblocks)
+            accf = alg.wire_decode(acc.reshape(sblocks, block), my_scale)
+            if average:
+                accf = accf / n
+            # Dense parity: the dense chain requantizes the combined
+            # shard onto the wire for its allgather; replay the same
+            # encode/decode roundtrip so shard bits match exactly.
+            w2, s2 = alg.wire_encode(accf)
+            outs.append(alg.wire_decode(w2, s2).reshape(-1))
+        else:
+            sh = lax.psum_scatter(ch, axis_name, scatter_dimension=0,
+                                  tiled=True)
+            if average:
+                sh = sh / n
+            outs.append(sh)
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+
+
 def matmul_reducescatter(x: jax.Array, w: jax.Array, axis_name: str, *,
                          chunks: int = 2) -> jax.Array:
     """Row-parallel projection ``psum(x @ w, axis)`` as a chunked

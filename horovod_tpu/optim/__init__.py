@@ -8,3 +8,4 @@ from .distributed import (  # noqa: F401
     DistributedGradientTransformation,
     distributed_gradients,
 )
+from .zero import ZeroDistributedOptimizer  # noqa: F401

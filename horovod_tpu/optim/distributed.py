@@ -180,7 +180,14 @@ def DistributedGradientTransformation(
             counter=jnp.zeros((), jnp.int32))
 
     def update(grads, state, params=None):
-        acc = jax.tree.map(jnp.add, state.acc, grads)
+        # Accumulate in the GRADIENT dtype: ``init`` seeds the
+        # accumulator as zeros_like(params), and with bf16 params +
+        # fp32 grads a param-dtype accumulator would round every
+        # micro-batch's contribution onto the bf16 grid before the sum.
+        # The explicit widen keeps the accumulator in the grad dtype
+        # from the first pass on (zeros cast losslessly).
+        acc = jax.tree.map(lambda a, g: a.astype(g.dtype) + g,
+                           state.acc, grads)
         counter = state.counter + 1
         is_step = counter >= n
 
@@ -239,7 +246,11 @@ def distributed_gradients(per_rank_grads: Any,
         ctxs.append(ctx)
     handles = [hvd.allreduce_async(leaf, op, process_set=process_set, **kw)
                for leaf in compressed]
-    reduced = [compression.decompress(h.wait(), ctx)
+    # Engine-side (quantized) compressors dequantize inside the fused
+    # collective — the engine output is already fp32, so the host-side
+    # decompress must NOT run again (a lossy Compressor whose decompress
+    # is not the identity would corrupt the result).
+    reduced = [h.wait() if kw else compression.decompress(h.wait(), ctx)
                for h, ctx in zip(handles, ctxs)]
     return jax.tree.unflatten(treedef, reduced)
 

@@ -1,0 +1,202 @@
+"""Flatten/partition plan for the ZeRO-1 sharded optimizer.
+
+The sharded optimizer (:mod:`.zero`) keeps only the 1/n gradient shard
+the reduce-scatter produces and runs the inner optax transformation on
+that shard.  For the shard to be well defined — and for the quantized
+wire modes to stay bit-exact against the dense path — every leaf must be
+padded to a *shard-divisible* size whose unit matches the schedule
+lowerer's chunk unit (:func:`~..ops.sched.lower.chunk_layout`):
+
+- fp32 / cast leaves pad to a multiple of ``n``;
+- quantized leaves pad to a multiple of ``n * block`` so that quant
+  *block* boundaries land identically to the dense per-leaf path (each
+  leaf starts on a block boundary inside its bucket, so per-block shared
+  scales — and therefore every quantized bit — match the dense
+  ``overlap_allreduce`` chain).
+
+Leaves are then grouped into size-targeted *buckets* (the Horovod fusion
+-buffer analogue, ``HOROVOD_TPU_BUCKET_BYTES``): each bucket is one
+contiguous flat buffer = the concatenation of its padded leaves, one
+reduce-scatter chain per bucket, and ONE parameter allgather per bucket
+closes the step.  Buckets never mix dtypes or wire modes.
+
+Shard layout: a bucket of ``P`` padded elements is chunked by
+``chunk_layout`` into ``k`` chunks; ``psum_scatter`` over chunk *c*
+hands rank *r* the contiguous slice ``[r*clen/n, (r+1)*clen/n)`` of that
+chunk, so the rank's bucket shard is the chunk-major concatenation of
+those slices (``P/n`` elements total).  :func:`extract_shard` and
+:func:`assemble_from_shards` are the exact inverse pair for that layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops.sched.lower import chunk_layout
+
+
+class LeafSpec(NamedTuple):
+    """Static geometry of one pytree leaf inside its bucket."""
+    index: int          # position in the flattened pytree
+    shape: tuple
+    dtype: Any
+    numel: int
+    padded: int         # numel rounded up to the bucket's unit
+    offset: int         # offset of this leaf inside the bucket's flat buffer
+
+
+class BucketSpec(NamedTuple):
+    """One fusion bucket: same-dtype, same-wire-mode leaves."""
+    leaves: tuple       # tuple[LeafSpec, ...] in pytree order
+    numel: int          # sum of padded leaf sizes (multiple of the unit)
+    shard: int          # numel // n
+    mode: str           # "fp32" or a quant wire mode ("int8"/"fp8")
+    dtype: Any          # the common leaf dtype
+
+
+class Plan(NamedTuple):
+    """The full partition plan — static, derived from shapes/dtypes and
+    config only, so every rank computes the identical plan."""
+    n: int
+    block: int
+    chunks: int
+    treedef: Any
+    buckets: tuple      # tuple[BucketSpec, ...]
+    numel: int          # total unpadded elements
+    padded: int         # total padded elements
+    shard_numel: int    # padded // n
+
+
+def _pad_unit(mode: str, n: int, block: int) -> int:
+    return n * block if mode not in ("fp32", "bf16", "fp16") else n
+
+
+def build_plan(params: Any, n: int, *, modes: Sequence[str],
+               block: int = 512, chunks: int = 2,
+               bucket_bytes: int = 0) -> Plan:
+    """Build the partition plan for ``params`` over ``n`` shards.
+
+    ``modes[i]`` is the resolved wire mode of leaf *i* ("fp32" for
+    unquantized, the wire mode for engine-side quant leaves above the
+    size floor).  ``bucket_bytes <= 0`` means unbounded buckets — one
+    bucket per (dtype, mode) group, i.e. literally one parameter
+    allgather per group.
+    """
+    leaves, treedef = jax.tree.flatten(params)
+    if len(modes) != len(leaves):
+        raise ValueError(f"modes has {len(modes)} entries for "
+                         f"{len(leaves)} leaves")
+    buckets: list[BucketSpec] = []
+    # Greedy size-targeted grouping in pytree order; a bucket closes when
+    # adding the next leaf of its (dtype, mode) group would exceed the
+    # byte target (a single oversized leaf still gets its own bucket).
+    open_by_key: dict = {}
+    order: list = []
+    for i, (leaf, mode) in enumerate(zip(leaves, modes)):
+        arr = jnp.asarray(leaf)
+        dtype = jnp.dtype(arr.dtype)
+        unit = _pad_unit(mode, n, block)
+        numel = int(np.prod(arr.shape)) if arr.shape else 1
+        padded = max(1, -(-numel // unit)) * unit
+        key = (str(dtype), mode)
+        cur = open_by_key.get(key)
+        cur_bytes = (sum(s.padded for s in cur) * dtype.itemsize
+                     if cur else 0)
+        if cur is None or (bucket_bytes > 0 and cur and
+                           cur_bytes + padded * dtype.itemsize
+                           > bucket_bytes):
+            cur = []
+            open_by_key[key] = cur
+            order.append((key, cur, mode, dtype))
+        off = sum(s.padded for s in cur)
+        cur.append(LeafSpec(index=i, shape=tuple(arr.shape), dtype=dtype,
+                            numel=numel, padded=padded, offset=off))
+    for (_key, specs, mode, dtype) in order:
+        total = sum(s.padded for s in specs)
+        buckets.append(BucketSpec(leaves=tuple(specs), numel=total,
+                                  shard=total // n, mode=mode,
+                                  dtype=dtype))
+    numel = sum(s.numel for b in buckets for s in b.leaves)
+    padded = sum(b.numel for b in buckets)
+    return Plan(n=n, block=block, chunks=chunks, treedef=treedef,
+                buckets=tuple(buckets), numel=numel, padded=padded,
+                shard_numel=padded // n)
+
+
+def bucket_layout(plan: Plan, bucket: BucketSpec) -> tuple:
+    """Chunk layout of one bucket's flat buffer — the exact layout the
+    reduce-scatter chain and the shard extract/assemble pair share.
+    ``bucket.numel`` is already unit-aligned, so this never re-pads."""
+    return tuple(chunk_layout(bucket.numel, plan.n, max(1, plan.chunks),
+                              bucket.mode, plan.block))
+
+
+def flatten_bucket(bucket: BucketSpec, leaves: Sequence[Any]) -> jax.Array:
+    """Concatenate a bucket's leaves (from the *full* flattened pytree
+    leaf list) into its padded flat buffer."""
+    parts = []
+    for spec in bucket.leaves:
+        flat = jnp.asarray(leaves[spec.index]).reshape(-1)
+        if spec.padded != spec.numel:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((spec.padded - spec.numel,), flat.dtype)])
+        parts.append(flat)
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def unflatten_bucket(bucket: BucketSpec, flat: jax.Array) -> list:
+    """Inverse of :func:`flatten_bucket`: ``[(leaf_index, array), ...]``
+    with each leaf reshaped (padding dropped)."""
+    out = []
+    for spec in bucket.leaves:
+        leaf = lax.dynamic_slice_in_dim(flat, spec.offset, spec.padded)
+        out.append((spec.index,
+                    leaf[:spec.numel].reshape(spec.shape)))
+    return out
+
+
+def extract_shard(flat: jax.Array, me, layout: Sequence[int],
+                  n: int) -> jax.Array:
+    """Rank ``me``'s shard of a bucket's flat buffer, chunk-major — the
+    same element order ``psum_scatter`` hands that rank per chunk."""
+    parts = []
+    off = 0
+    for clen in layout:
+        piece = clen // n
+        parts.append(lax.dynamic_slice_in_dim(flat, off + me * piece,
+                                              piece))
+        off += clen
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def assemble_from_shards(gathered: jax.Array, layout: Sequence[int],
+                         n: int) -> jax.Array:
+    """Rebuild the full bucket buffer from the tiled allgather of every
+    rank's shard (``gathered``: flat ``[n * shard]``, rank-major)."""
+    shard = gathered.shape[0] // n
+    rows = gathered.reshape(n, shard)
+    chunks = []
+    soff = 0
+    for clen in layout:
+        piece = clen // n
+        # rows[:, soff:soff+piece] is chunk c's per-rank pieces; rank-
+        # major flatten IS the chunk's original element order.
+        chunks.append(lax.dynamic_slice_in_dim(
+            rows, soff, piece, axis=1).reshape(-1))
+        soff += piece
+    return chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks)
+
+
+def shard_bytes(tree: Any) -> int:
+    """Total bytes of a pytree of (possibly traced) arrays — static
+    shape/dtype arithmetic only, safe under jit."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        arr = leaf if hasattr(leaf, "dtype") else jnp.asarray(leaf)
+        total += int(np.prod(arr.shape)) * jnp.dtype(arr.dtype).itemsize
+    return total

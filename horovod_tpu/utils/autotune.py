@@ -12,7 +12,7 @@ kernel + expected improvement over a candidate grid).  Eigen/LBFGS hyperparam
 refits are replaced by a small fixed-length-scale kernel — adequate for a
 low-noise search space.
 
-Knob space, v4: 5-D.  Beyond the reference's (threshold, cycle-time),
+Knob space, v5: 6-D.  Beyond the reference's (threshold, cycle-time),
 the third dimension is the engine's **wire precision**
 (``ops/reduction.py``): fp32, bf16, or block-scaled int8; the fourth is
 the **collective schedule** (``ops/sched``, arm set derived from
@@ -23,7 +23,11 @@ the fifth is the **hierarchy split** (``ops/hierarchical`` + the sched
 executor's ``hier:<n_local>:<k>`` path): flat, the topology-detected
 two-tier split, or the detected split halved — HiCCL's level-split
 selection as a search dimension, seeded by the perfmodel's analytic
-per-message-size decision table (logged at init).
+per-message-size decision table (logged at init); the sixth is the
+**bucket cap** (``config.bucket_bytes``): the size target the backward
+bucketer and the engine's fusion grouping both honor — 0 (uncapped,
+fusion threshold alone governs) or a candidate cap that trades fewer,
+larger collectives against earlier dispatch of the first gradients.
 The score is *effective* bytes/s — logical fp32 payload bytes per cycle
 second — so a mode that moves fewer wire bytes (or overlaps more of its
 communication) in less time scores higher, and the GP picks what the
@@ -36,7 +40,10 @@ to the configured defaults: each rank scores from rank-local timings,
 and a per-rank commit of any of them would resolve the same tensor to
 different wire modes / chunk programs / tier meshes on different ranks
 at enqueue — divergent fused XLA dispatches across processes, i.e. a
-hang.  Single-controller mode (one process, all devices) tunes all five
+hang.  The bucket cap stays searchable even then, for the same reason
+the threshold does: it only shapes the local cycle thread's fusion
+grouping, and group composition still agrees via negotiation order.
+Single-controller mode (one process, all devices) tunes all six
 dimensions.
 
 Tensor-size bucketing: the precision knob governs the *quantizable
@@ -71,6 +78,11 @@ _WIRE_MODES = ["fp32", "bf16", "int8"]
 # searching (higher counts add dispatch overhead faster than they add
 # overlap window; 2 and 4 bracket the useful range).
 _SCHED_CHUNK_COUNTS = (2, 4)
+# Bucket-cap dimension (config.bucket_bytes): 0 means uncapped — the
+# fusion threshold alone governs grouping — plus the caps worth
+# searching (a small cap dispatches the first backward buckets sooner;
+# a large one amortizes per-collective overhead).
+_BUCKET_BYTES = [0, 4 << 20, 32 << 20]
 
 
 def _sched_arms() -> list:
@@ -98,7 +110,7 @@ _m_cycle_ms = _obs.gauge(
 
 
 class _GP:
-    """Minimal RBF-kernel GP regressor for the 5-D knob space."""
+    """Minimal RBF-kernel GP regressor for the 6-D knob space."""
 
     def __init__(self, length_scale: float = 1.0, noise: float = 1e-3) -> None:
         self.ls = length_scale
@@ -137,14 +149,15 @@ class Autotuner:
     """Propose/score loop attached to the engine's cycle callback."""
 
     def _norm_point(self, threshold: int, cycle_ms: float, mode: str,
-                    sched: str, hier: str
-                    ) -> tuple[float, float, float, float, float]:
-        """Raw knobs -> GP coordinates (mode/sched/hier indices are
-        instance-local)."""
+                    sched: str, hier: str, bucket: int
+                    ) -> tuple[float, float, float, float, float, float]:
+        """Raw knobs -> GP coordinates (mode/sched/hier/bucket indices
+        are instance-local)."""
         return (math.log2(threshold), math.log2(cycle_ms),
                 self._modes.index(mode) * _MODE_SCALE,
                 self._scheds.index(sched) * _MODE_SCALE,
-                self._hiers.index(hier) * _MODE_SCALE)
+                self._hiers.index(hier) * _MODE_SCALE,
+                self._buckets.index(bucket) * _MODE_SCALE)
 
     def __init__(self, state) -> None:
         self._state = state
@@ -204,6 +217,13 @@ class Autotuner:
             nl0 = cfg.hierarchical_local_size or detected
             if nl0 and 1 < nl0 < n and n % nl0 == 0:
                 hier_default = f"tier:{int(nl0)}"
+        # Bucket-cap dimension: like the threshold, it only shapes the
+        # local cycle thread's fusion grouping, so it stays searchable
+        # even in distributed mode (module docstring).  An off-grid
+        # configured cap joins the candidates instead of being reverted.
+        bucket_default = int(getattr(cfg, "bucket_bytes", 0) or 0)
+        self._buckets = list(_BUCKET_BYTES) + (
+            [bucket_default] if bucket_default not in _BUCKET_BYTES else [])
         if distributed:
             self._modes = [default]
             self._scheds = [sched_default]
@@ -217,9 +237,10 @@ class Autotuner:
                 else [])
             self._hiers = hier_vals + (
                 [hier_default] if hier_default not in hier_vals else [])
-        self._grid_raw = [(t, c, m, s, h) for t in _THRESHOLDS
+        self._grid_raw = [(t, c, m, s, h, b) for t in _THRESHOLDS
                           for c in _CYCLE_TIMES for m in self._modes
-                          for s in self._scheds for h in self._hiers]
+                          for s in self._scheds for h in self._hiers
+                          for b in self._buckets]
         self._grid = np.array([self._norm_point(*p) for p in self._grid_raw])
         # Seed the hierarchy dimension with the perfmodel's analytic
         # per-message-size split table (logged, and kept on the instance
@@ -247,11 +268,12 @@ class Autotuner:
         # cycle-time exactly on the candidate grid — the round-trip
         # drifted (e.g. 2.5 ms -> 2.4999999999999996) so the converged
         # knobs were values no candidate ever proposed.
-        self._samples_X: list[tuple[float, float, float, float, float]] = []
-        self._samples_raw: list[tuple[int, float, str, str, str]] = []
+        self._samples_X: list[
+            tuple[float, float, float, float, float, float]] = []
+        self._samples_raw: list[tuple[int, float, str, str, str, int]] = []
         self._samples_y: list[float] = []
         self._current = (cfg.fusion_threshold, cfg.cycle_time_ms, default,
-                         sched_default, hier_default)
+                         sched_default, hier_default, bucket_default)
         self._acc_bytes = 0
         self._acc_time = 0.0
         self._acc_cycles = 0
@@ -278,9 +300,9 @@ class Autotuner:
             self._warmup_left -= 1
             self._log(f"warmup score={score:.3e}")
             return
-        t, c, m, s, h = self._current
-        self._samples_X.append(self._norm_point(t, c, m, s, h))
-        self._samples_raw.append((t, c, m, s, h))
+        t, c, m, s, h, b = self._current
+        self._samples_X.append(self._norm_point(t, c, m, s, h, b))
+        self._samples_raw.append((t, c, m, s, h, b))
         self._samples_y.append(score)
         _m_trials.inc()
         _m_score.set(score)
@@ -295,36 +317,37 @@ class Autotuner:
         mu, var = gp.predict(self._grid)
         ei = _expected_improvement(mu, var, y_norm.max())
         idx = int(np.argmax(ei))
-        threshold, cycle, mode, sched, hier = self._grid_raw[idx]
-        self._apply(threshold, cycle, mode, sched, hier)
+        threshold, cycle, mode, sched, hier, bucket = self._grid_raw[idx]
+        self._apply(threshold, cycle, mode, sched, hier, bucket)
         best = int(np.argmax(y))
         self._log(
             f"sample #{len(y)} score={y[-1]:.3e} -> next "
             f"threshold={threshold} cycle_ms={cycle} wire={mode} "
-            f"sched={sched} hier={hier} "
+            f"sched={sched} hier={hier} bucket={bucket} "
             f"(best so far {self._raw(best)} @ {y[best]:.3e})")
         # Convergence: stop after exploring enough with no improvement,
         # committing the best-seen knobs († ParameterManager stops tuning).
         if len(y) >= 12 and best < len(y) - 6:
-            bt, bc, bm, bs, bh = self._raw(best)
-            self._apply(bt, bc, bm, bs, bh)
+            bt, bc, bm, bs, bh, bb = self._raw(best)
+            self._apply(bt, bc, bm, bs, bh, bb)
             self._done = True
             self._log(f"converged: threshold={bt} cycle_ms={bc} "
-                      f"wire={bm} sched={bs} hier={bh}")
+                      f"wire={bm} sched={bs} hier={bh} bucket={bb}")
 
-    def _raw(self, i: int) -> tuple[int, float, str, str, str]:
+    def _raw(self, i: int) -> tuple[int, float, str, str, str, int]:
         """Exact grid knobs of sample *i* — from the raw record, never a
         ``2 ** log2(x)`` round-trip of the normalized GP coordinates."""
         return self._samples_raw[i]
 
     def _apply(self, threshold: int, cycle_ms: float, mode: str,
-               sched: str, hier: str) -> None:
+               sched: str, hier: str, bucket: int = 0) -> None:
         from ..ops.sched import parse_compiled_descriptor, parse_descriptor
-        self._current = (threshold, cycle_ms, mode, sched, hier)
+        self._current = (threshold, cycle_ms, mode, sched, hier, bucket)
         self._settle_left = _SETTLE_CYCLES
         self._state.config.fusion_threshold = threshold
         self._state.config.cycle_time_ms = cycle_ms
         self._state.config.wire_precision = mode
+        self._state.config.bucket_bytes = bucket
         ck = parse_compiled_descriptor(sched)
         if sched == "monolithic":
             self._state.config.sched_mode = "monolithic"

@@ -395,9 +395,136 @@ def main_compiled() -> int:
     return 0
 
 
+def main_zero() -> int:
+    """ZeRO-1 + bucketed overlap over the negotiated transport.
+
+    ``HVDTPU_TEST_MODE=zero`` (np=2 and np=4, the ci.yaml zero1-parity
+    job).  Four phases:
+
+    1. the ZeRO-1 wire pattern as REAL collectives — reduce-scatter the
+       gradient to this rank's shard, update 1/n of the parameters
+       locally, one parameter allgather — vs the dense step (full
+       allreduce + full local update).  Same association contract as
+       the decomposed battery: bit-exact at np=2 (two-operand adds
+       commute), <= 2 ulp relative at np>=4 (rs+ag re-associates the
+       ring sum);
+    2. :func:`bucketed_distributed_gradients` parity vs the unbucketed
+       engine path, fp32 AND int8, under the decomposed schedule with a
+       cap that forces several buckets (per-bucket nudges must not
+       change values: entries are block-aligned, so fusion regrouping
+       cannot move quant block boundaries — bit-exact both modes);
+    3. the compiled zero-dispatch guard: the same bucketed reduction
+       under ``sched_mode=compiled`` must ride the single-program
+       backend (compiled counter moves) with ZERO new per-chunk engine
+       dispatches;
+    4. join/rebuild: rank 0 joins first; survivors keep issuing
+       bucketed decomposed reductions the joined rank must rebuild from
+       the echoed ``sc`` meta (completion + value check assert it).
+    """
+    from horovod_tpu.ops.sched.compiled import _m_compiled
+    from horovod_tpu.ops.sched.executor import _m_sched
+
+    hvd.init()
+    me, n = hvd.rank(), hvd.size()
+    cfg = hvd.global_state().config
+    cfg.quant_min_bytes = 0
+    # entry is a multiple of quant_block_size, so bucket regrouping in
+    # phase 2 never moves a block boundary.
+    entry = max(2048, 2 * n * cfg.quant_block_size)
+    numel = n * entry
+    lr = np.float32(0.1)
+    eps = np.finfo(np.float32).eps
+    params = np.random.RandomState(7).randn(numel).astype(np.float32)
+    grads = [np.random.RandomState(500 + r).randn(numel).astype(np.float32)
+             for r in range(n)]
+
+    # -- phase 1: sharded step vs dense step ---------------------------
+    g_sum = hvd.to_numpy(hvd.allreduce(
+        hvd.from_local(grads[me][None]), hvd.Sum)).reshape(-1)
+    p_dense = params - lr * (g_sum / np.float32(n))
+    shard_red = hvd.to_local(hvd.reducescatter(
+        hvd.from_local(grads[me][None]), hvd.Sum)).reshape(-1)
+    my_params = params.reshape(n, entry)[me]
+    shard_new = my_params - lr * (shard_red / np.float32(n))
+    p_zero = hvd.to_numpy(hvd.allgather(
+        hvd.from_local(shard_new[None]))).reshape(-1)
+    if n == 2:
+        assert np.array_equal(p_dense, p_zero), \
+            np.abs(p_dense - p_zero).max()
+        tag = "bit-exact"
+    else:
+        rel = np.abs(p_dense - p_zero).max() / max(
+            1e-30, np.abs(p_dense).max())
+        assert rel <= 2 * eps, rel
+        tag = f"ulp-bounded rel={rel:.1e}"
+    print(f"rank {me}: zero1 step {tag}", flush=True)
+
+    # -- phase 2: bucketed eager parity, fp32 + int8 -------------------
+    cfg.sched_mode, cfg.sched_chunks = "decomposed", 2
+    cap = 2 * entry * 4   # two fp32 entries per bucket -> two buckets
+    for mode in (None, "int8"):
+        kw = {"compression": hvd.Compression.int8} if mode else {}
+        tree = {f"g{i}": hvd.from_local(
+            grads[me][None, i * entry:(i + 1) * entry])
+            for i in range(4)}
+        base = hvd.distributed_gradients(tree, **kw)
+        tree = {f"g{i}": hvd.from_local(
+            grads[me][None, i * entry:(i + 1) * entry])
+            for i in range(4)}
+        got = hvd.bucketed_distributed_gradients(tree, bucket_bytes=cap,
+                                                 **kw)
+        for k in sorted(base):
+            b, g = hvd.to_numpy(base[k]), hvd.to_numpy(got[k])
+            assert np.array_equal(b, g), (
+                mode or "fp32", k, np.abs(b - g).max())
+        print(f"rank {me}: {mode or 'fp32'} bucketed bit-exact",
+              flush=True)
+
+    # -- phase 3: compiled zero-dispatch guard -------------------------
+    cfg.sched_mode, cfg.sched_chunks = "compiled", 2
+    sched_before = _m_sched.total()
+    before = _m_compiled.total()
+    tree = {f"c{i}": hvd.from_local(
+        grads[me][None, i * entry:(i + 1) * entry]) for i in range(4)}
+    out = hvd.bucketed_distributed_gradients(tree, bucket_bytes=cap)
+    want = np.stack(grads).mean(0)
+    for i in range(4):
+        g = hvd.to_numpy(out[f"c{i}"]).reshape(-1)
+        w = want[i * entry:(i + 1) * entry]
+        if n == 2:
+            assert np.array_equal(g, w)
+        else:
+            assert np.allclose(g, w, atol=1e-5)
+    assert _m_compiled.total() > before, (
+        "compiled bucketed pass never hit the compiled backend")
+    assert _m_sched.total() == sched_before, (
+        "compiled bucketed pass leaked per-chunk engine dispatches")
+    print(f"rank {me}: compiled bucketed zero-dispatch", flush=True)
+
+    # -- phase 4: join/rebuild through the bucketed path ---------------
+    cfg.sched_mode, cfg.sched_chunks = "decomposed", 2
+    steps = 1 if me == 0 else 3
+    for step in range(steps):
+        tree = {"j": hvd.from_local(grads[me][None, :4096] + float(step))}
+        out = hvd.bucketed_distributed_gradients(tree, bucket_bytes=4096)
+        got = hvd.to_numpy(out["j"]).reshape(-1)
+        if step == 0:
+            want = np.stack([g[:4096] for g in grads]).sum(0) / n
+        else:
+            want = sum(g[:4096] + step for g in grads[1:]) / n
+        assert np.allclose(got, want, atol=1e-5), (me, step)
+    last = hvd.join(timeout=120)
+    assert last >= 0
+    print(f"rank {me}: ZERO-OK", flush=True)
+    hvd.shutdown()
+    return 0
+
+
 if __name__ == "__main__":
     if os.environ.get("HVDTPU_TEST_MODE") == "hier":
         sys.exit(main_hier())
     if os.environ.get("HVDTPU_TEST_MODE") == "compiled":
         sys.exit(main_compiled())
+    if os.environ.get("HVDTPU_TEST_MODE") == "zero":
+        sys.exit(main_zero())
     sys.exit(main())

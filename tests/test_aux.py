@@ -162,7 +162,7 @@ def test_autotuner_proposes_and_converges(tmp_path):
     for i in range(200):
         if at._done:
             break
-        t, c, m, s, h = at._current
+        t, c, m, s, h, b = at._current
         score_bias = 1.0 + (np.log2(t) - 20) * 0.1
         at.record_cycle(int(1e6 * score_bias), 0.001)
     log = (tmp_path / "at.log").read_text()
@@ -198,7 +198,7 @@ def test_autotuner_commits_exact_grid_values(tmp_path):
         # Flat-ish noisy scores: convergence picks SOME sampled config.
         at.record_cycle(int(1e6 + rng.randint(0, 1000)), 0.001)
     assert at._done, "tuner never converged"
-    t, c, m, s, h = at._current
+    t, c, m, s, h, b = at._current
     assert t in _THRESHOLDS or t == st.config.fusion_threshold
     assert st.config.fusion_threshold == t
     # The drift bug showed up in the float knob: exact membership now.
@@ -216,15 +216,18 @@ def test_autotuner_commits_exact_grid_values(tmp_path):
     else:
         assert st.config.sched_mode == "decomposed"
         assert f"rs_ag:{st.config.sched_chunks}" == s
+    assert b in at._buckets
+    assert st.config.bucket_bytes == b
     # Every recorded sample keeps exact raw knobs alongside the GP coords
-    # — all five of them, so the hierarchy dimension cannot reintroduce
-    # the round-trip drift either.
-    for (rt, rc, rm, rs, rh), (xt, xc, xm, xs, xh) in zip(at._samples_raw,
-                                                          at._samples_X):
+    # — all six of them, so neither the hierarchy nor the bucket-cap
+    # dimension can reintroduce the round-trip drift.
+    for (rt, rc, rm, rs, rh, rb), (xt, xc, xm, xs, xh, xb) in zip(
+            at._samples_raw, at._samples_X):
         assert rt in _THRESHOLDS or rt == 64 * 1024 * 1024
         assert rc in _CYCLE_TIMES or rc == 2.5
         assert rs in arms
         assert rh in at._hiers
+        assert rb in at._buckets
         assert 2.0 ** xt == pytest.approx(rt)
 
 
@@ -363,6 +366,10 @@ def test_autotuner_pins_sched_and_mode_when_distributed():
     assert {g[2] for g in at._grid_raw} == {"int8"}
     assert {g[3] for g in at._grid_raw} == {"rs_ag:2"}
     assert {g[4] for g in at._grid_raw} == {"flat"}
+    # The bucket cap stays SEARCHABLE even when distributed: like the
+    # fusion threshold it only shapes the local cycle thread's grouping.
+    assert {g[5] for g in at._grid_raw} == set(at._buckets)
+    assert len(at._buckets) > 1
 
 
 def test_autotuner_hierarchy_dimension():
@@ -404,6 +411,35 @@ def test_autotuner_hierarchy_dimension():
     at2 = Autotuner(st2)
     assert at2._hiers == ["tier:4"]
     assert {g[4] for g in at2._grid_raw} == {"tier:4"}
+
+
+def test_autotuner_bucket_bytes_dimension():
+    """The 6th knob: bucket cap candidates include 0 (uncapped) plus the
+    grid caps, an off-grid configured cap joins the search, and _apply
+    commits ``config.bucket_bytes`` (which the engine folds into its
+    fusion grouping and the backward bucketer reads as its size target).
+    """
+    from horovod_tpu.utils.autotune import _BUCKET_BYTES, Autotuner
+
+    class FakeState:
+        pass
+
+    from horovod_tpu import config as config_mod
+    st = FakeState()
+    st.config = config_mod.Config(
+        autotune=True, autotune_warmup_samples=0,
+        autotune_steps_per_sample=1, bucket_bytes=7 << 20)
+    at = Autotuner(st)
+    assert at._buckets == list(_BUCKET_BYTES) + [7 << 20]
+    assert 0 in at._buckets
+    assert at._current[5] == 7 << 20
+    at._apply(1 << 20, 1.0, "fp32", "monolithic", "flat", 4 << 20)
+    assert st.config.bucket_bytes == 4 << 20
+    at._apply(1 << 20, 1.0, "fp32", "monolithic", "flat", 0)
+    assert st.config.bucket_bytes == 0
+    # Default-arg form (legacy 5-knob callers) commits the uncapped arm.
+    at._apply(1 << 20, 1.0, "fp32", "monolithic", "flat")
+    assert st.config.bucket_bytes == 0
 
 
 @pytest.mark.integration

@@ -66,6 +66,51 @@ def test_chunking_multiplies_steps_not_wire(k):
     assert dec.schedule == f"rs_ag:{k}"
 
 
+def test_expected_zero_step_fp32_matches_dense_wire():
+    """The ZeRO-1 claim in wire terms: rs half of the gradient plus one
+    raw parameter allgather sums to EXACTLY the dense allreduce wire for
+    fp32 (param_bytes == payload_bytes), while the latency-step count
+    only drops the gradient-allgather chunks."""
+    from horovod_tpu.obs.perfmodel import expected_zero_step
+    for n in (2, 4, 8):
+        dense = expected_allreduce(1 << 20, n, mode="fp32", chunks=4)
+        zero = expected_zero_step(1 << 20, n, mode="fp32", chunks=4)
+        assert zero.wire_bytes == pytest.approx(dense.wire_bytes)
+        assert zero.verb == "zero_step"
+        assert zero.schedule == "zero1:rs_ag:4"
+        assert zero.steps == (n - 1) * 4 + (n - 1)
+        assert set(zero.tiers) == {"rs", "param_ag"}
+        assert zero.tiers["rs"].wire_bytes \
+            + zero.tiers["param_ag"].wire_bytes \
+            == pytest.approx(zero.wire_bytes)
+
+
+def test_expected_zero_step_quant_tiers_and_compiled():
+    """Quant ZeRO: only the rs tier keeps the narrow wire width (half
+    the quant allreduce per-element cost); the param allgather moves raw
+    fp32 param bytes — dearer than dense's quantized allgather half, and
+    the model shows that trade instead of hiding it.  Compiled collapses
+    per-chunk dispatch steps to one ring."""
+    from horovod_tpu.obs.perfmodel import expected_zero_step
+    numel = (1 << 20) / 4
+    frac = 7 / 8
+    zero = expected_zero_step(1 << 20, 8, mode="int8", chunks=2,
+                              block=512)
+    assert zero.tiers["rs"].wire_bytes == pytest.approx(
+        frac * (wire_per_elem("int8", block=512) / 2.0) * numel)
+    assert zero.tiers["param_ag"].wire_bytes == pytest.approx(
+        frac * (1 << 20))
+    dense = expected_allreduce(1 << 20, 8, mode="int8", chunks=2,
+                               block=512)
+    assert zero.tiers["rs"].wire_bytes < dense.wire_bytes
+    assert zero.wire_bytes > dense.wire_bytes   # the exactness premium
+    compiled = expected_zero_step(1 << 20, 8, mode="int8", chunks=2,
+                                  compiled=True)
+    assert compiled.steps == 2 * 7
+    assert compiled.schedule == "zero1:compiled:rs_ag:2"
+    assert compiled.wire_bytes == pytest.approx(zero.wire_bytes)
+
+
 @pytest.mark.parametrize("verb", ("allgather", "alltoall",
                                   "reducescatter", "broadcast"))
 def test_single_phase_verbs(verb):
