@@ -5,13 +5,19 @@
 PY ?= python
 
 .PHONY: ci native test mp-test examples bench baseline-table image \
-	autoscale-recovery perf-regress bench-trajectory hierarchical-parity \
-	compiled-parity zero1-parity
+	autoscale-recovery disagg-recovery perf-regress bench-trajectory \
+	hierarchical-parity compiled-parity zero1-parity
 
 # The autoscale-recovery CI job standalone: np=4 MoE job, injected rank
 # death + SLO load spike => shrink to np=2, grow back to np=4.
 autoscale-recovery:
 	$(PY) -m horovod_tpu.chaos.run --scenario autoscale
+
+# The disagg-recovery CI job standalone: np=4 (2 prefill + 2 decode
+# pools), injected prefill-replica death mid-migration => durable-point
+# replay, token-identical completion, decode pool never dips.
+disagg-recovery:
+	$(PY) -m horovod_tpu.chaos.run --scenario disagg
 
 ci: native
 	$(PY) -c "import horovod_tpu, horovod_tpu.torch, horovod_tpu.tensorflow, \
@@ -25,6 +31,7 @@ horovod_tpu.serving"
 	$(PY) -m horovod_tpu.chaos.run --np 4
 	$(PY) -m horovod_tpu.chaos.run --scenario router
 	$(PY) -m horovod_tpu.chaos.run --scenario autoscale
+	$(PY) -m horovod_tpu.chaos.run --scenario disagg
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 # The compiled-parity CI job standalone: np=2 and np=4, compiled:rs_ag:2

@@ -342,6 +342,21 @@ def _extract_bench_file(path: str) -> list:
             rows.append(_row(round_id, order, mt, val,
                              unit=ent.get("unit", ""),
                              device_kind=kind, source=name))
+    # r13 disagg section (serving_bench.py --disagg): per-fleet decode
+    # ITL rows ("itl" auto-resolves lower-is-better) plus the isolation
+    # advantage ratio (higher-is-better).
+    dg = d.get("disagg")
+    if isinstance(dg, list):
+        for ent in dg:
+            if not isinstance(ent, dict):
+                continue
+            mt, val = ent.get("metric"), ent.get("value")
+            if not mt or not isinstance(val, (int, float)):
+                continue
+            rows.append(_row(round_id, order, mt, val,
+                             unit=ent.get("unit", ""),
+                             device_kind=ent.get("device_kind", "cpu"),
+                             source=name))
     return [r for r in rows if r]
 
 

@@ -37,9 +37,13 @@ from ..utils import logging as hvd_logging
 
 log = hvd_logging.get_logger()
 
+#: labeled by pool so disaggregated serving fleets scale their prefill
+#: and decode pools independently; a whole-job controller (the elastic
+#: training path) writes the "all" child, and single-child snapshots
+#: keep reading ``samples[0]["value"]`` unchanged.
 _m_target = _obs.gauge(
     "hvd_autoscale_target_np",
-    "world size the autoscale policy currently wants")
+    "world size the autoscale policy currently wants", ("pool",))
 _m_current = _obs.gauge(
     "hvd_elastic_current_np",
     "world size of the running assignment")
@@ -60,7 +64,8 @@ _m_stale = _obs.counter(
 
 def signals_from_families(families: list, *, current_np: int,
                           available_slots: int,
-                          stale_after_s: float = 10.0) -> Signals:
+                          stale_after_s: float = 10.0,
+                          pool: Optional[str] = None) -> Signals:
     """Distill a merged ``/cluster`` snapshot into policy inputs.
 
     Rank-labeled samples from STALE ranks (snapshot age over
@@ -68,21 +73,44 @@ def signals_from_families(families: list, *, current_np: int,
     blobs linger in the KV store) are excluded — only fresh ranks vote.
     ``signal_age_s`` is the freshest rank's age: the policy goes no-op
     only when *everyone* is frozen, not when one rank lags.
+
+    With ``pool`` set, only ranks whose ``hvd_serving_pool_info`` sample
+    carries that pool label vote — a disaggregated fleet runs one
+    controller per pool, and a prefill-pool SLO burn must never grow
+    the decode pool (or vice versa).  Ranks that publish no pool tag
+    (training workers, old replicas) are excluded from a pool-filtered
+    view rather than voting in every pool.
     """
     ages: dict[str, float] = {}
+    pools: dict[str, str] = {}
     for fam in families:
-        if fam.get("name") == "horovod_tpu_rank_snapshot_age_seconds":
+        name = fam.get("name")
+        if name == "horovod_tpu_rank_snapshot_age_seconds":
             for s in fam.get("samples", ()):
                 r = s.get("labels", {}).get("rank")
                 if r is not None:
                     ages[str(r)] = float(s.get("value", 0.0))
+        elif name == "hvd_serving_pool_info":
+            for s in fam.get("samples", ()):
+                labels = s.get("labels", {})
+                r, p = labels.get("rank"), labels.get("pool")
+                if r is not None and p is not None:
+                    pools[str(r)] = str(p)
     fresh = {r for r, a in ages.items() if a <= stale_after_s}
+    if pool is not None:
+        fresh = {r for r in fresh if pools.get(r) == pool}
+        ages = {r: a for r, a in ages.items() if pools.get(r) == pool}
     age = min(ages.values()) if ages else float("inf")
 
     def fresh_samples(fam):
         for s in fam.get("samples", ()):
             r = s.get("labels", {}).get("rank")
-            if r is None or str(r) in fresh:
+            if r is None:
+                # Unranked samples (a driver-local gauge) vote in the
+                # whole-job view but not in any pool-filtered one.
+                if pool is None:
+                    yield s
+            elif str(r) in fresh:
                 yield s
 
     queue = 0.0
@@ -90,7 +118,7 @@ def signals_from_families(families: list, *, current_np: int,
     burn_fast = burn_slow = 0.0
     for fam in families:
         name = fam.get("name")
-        if name == "hvd_engine_queue_depth":
+        if name in ("hvd_engine_queue_depth", "hvd_serving_queue_depth"):
             for s in fresh_samples(fam):
                 queue = max(queue, float(s.get("value", 0.0)))
         elif name == "horovod_tpu_straggler":
@@ -130,6 +158,7 @@ class AutoscaleController:
                  set_target: Callable[[int], None] = lambda np: None,
                  prev_np: Optional[int] = None,
                  interval_s: float = 2.0,
+                 pool: Optional[str] = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         self._policy = policy
         self._np = int(current_np)
@@ -139,6 +168,8 @@ class AutoscaleController:
         self._set_target = set_target
         self._prev_np = prev_np
         self._interval = interval_s
+        self._pool = pool
+        self._m_target = _m_target.labels(pool=pool or "all")
         self._clock = clock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -148,7 +179,7 @@ class AutoscaleController:
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "AutoscaleController":
         _m_current.set(self._np)
-        _m_target.set(self._np)
+        self._m_target.set(self._np)
         if self._prev_np is not None and self._np < self._prev_np:
             # The shrink already happened (preempted/blacklisted host —
             # the driver relaunched us smaller); account for it as a
@@ -178,7 +209,8 @@ class AutoscaleController:
             families = []
         sig = signals_from_families(
             families, current_np=self._np, available_slots=cap,
-            stale_after_s=self._policy.config.stale_after_s)
+            stale_after_s=self._policy.config.stale_after_s,
+            pool=self._pool)
         decision = self._policy.decide(sig)
         if sig.signal_age_s == float("inf"):
             _m_stale.inc()
@@ -189,8 +221,8 @@ class AutoscaleController:
     def _record(self, d: Decision) -> None:
         self.decisions.append(d)
         _m_decisions.labels(action=d.action).inc()
-        _m_target.set(d.target_np if d.action != "hold"
-                      else max(self._np, _read_gauge(_m_target)))
+        self._m_target.set(d.target_np if d.action != "hold"
+                           else max(self._np, _read_gauge(self._m_target)))
         key = (d.action, d.target_np)
         if key != self._last_recorded:
             self._last_recorded = key

@@ -634,6 +634,184 @@ def scenario_router() -> None:
 
 
 # ---------------------------------------------------------------------------
+# scenario: disaggregated prefill/decode with a mid-migration kill
+# ---------------------------------------------------------------------------
+
+def disagg_worker_main(rank: int, pool: str) -> int:
+    """One pool-tagged disagg replica: session + ReplicaServer +
+    RankPublisher, serving until the parent writes ``fd/stop``.  The
+    victim prefill rank carries ``mig_export:die`` (armed via env at
+    package import) so it dies between migration blob publishes."""
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from .. import serving
+    from ..models import llama
+    from ..obs import flightrec
+    from ..obs.aggregate import RankPublisher, _kv_from_env
+    from ..serving.frontdoor.transport import ReplicaServer
+
+    flightrec.RECORDER.arm(os.environ.get("HVDTPU_FLIGHT_RECORDER_DIR"))
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    sess = serving.serve(params, cfg, num_blocks=64, block_size=8,
+                         max_active=4, use_flash="never",
+                         prefix_cache=True)
+    kv = _kv_from_env()
+    replica = ReplicaServer(sess, rank, pool=pool).start()
+    # 2s cadence -> 4s staleness tolerance: four CPU replicas compiling
+    # and decoding at once starve publisher threads for >1s routinely,
+    # and a transiently-late DECODE publish must not read as a pool dip
+    # when the fault targets a PREFILL rank.
+    pub = RankPublisher(rank, 4, interval_s=2.0).start()
+    sess.start()
+    try:
+        while kv.get("fd/stop") is None:
+            time.sleep(0.1)
+    finally:
+        pub.stop()
+        replica.stop()
+        sess.close()
+    return 0
+
+
+def scenario_disagg() -> None:
+    """np=4 disaggregated fleet (2 prefill + 2 decode replicas); a
+    ``mig_export:die`` kills one prefill replica between its migration
+    blob publishes (K landed, manifest did not).  Asserts: every
+    request completes token-identical to the greedy reference AND took
+    the migration path (``metrics["migrated"]``), the router recorded
+    the prefill-stage failover, ``hvd_disagg_pool_replicas{pool=
+    "decode"}`` never dropped below 2 (decode pool untouched by a
+    prefill kill), and the victim exited with ``DIE_EXIT_CODE``."""
+    import secrets
+    import subprocess
+
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from . import DIE_EXIT_CODE
+    from .._native import KvClient, KvServer
+    from ..models import llama
+    from ..obs import REGISTRY
+    from ..serving.disagg import DisaggRouter, DisaggRouterConfig
+    from ..serving.frontdoor.transport import KVReplicaClient
+
+    kv_srv = KvServer(secret=os.environ.setdefault(
+        "HVDTPU_SECRET", secrets.token_hex(8)))
+    os.environ["HVDTPU_RENDEZVOUS_ADDR"] = f"127.0.0.1:{kv_srv.port}"
+    env_base = dict(os.environ)
+    env_base["PYTHONPATH"] = os.pathsep.join(
+        [p for p in (os.getcwd(),
+                     os.environ.get("PYTHONPATH", "")) if p])
+    env_base.pop("HVDTPU_FAULTS", None)
+    env_base["HVDTPU_FLIGHT_RECORDER_DIR"] = \
+        tempfile.mkdtemp(prefix="hvdtpu-disagg-flightrec-")
+    die_latch = os.path.join(
+        tempfile.mkdtemp(prefix="hvdtpu-disagg-latch-"), "die")
+    pools = {0: "prefill", 1: "prefill", 2: "decode", 3: "decode"}
+    workers = []
+    for rank, pool in pools.items():
+        env = dict(env_base)
+        if rank == 0:
+            # Dies on its second mig_export traversal: the K payload is
+            # published, the V payload and manifest are not — the
+            # durable-point probe must come up empty and the router
+            # must re-prefill from the prompt on the pool survivor.
+            env["HVDTPU_FAULTS"] = \
+                f"mig_export:die:after=2:once={die_latch}"
+        workers.append(subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.chaos.run",
+             "--disagg-worker", str(rank), pool], env=env))
+    kv = KvClient("127.0.0.1", kv_srv.port, timeout_ms=5000)
+    try:
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if all(kv.get(f"fd/member/{r}") is not None
+                   and kv.get(f"obs/rank/{r}/meta") is not None
+                   for r in range(4)):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("disagg replicas never registered")
+
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+        def oracle(prompt, m):
+            full = np.asarray(llama.generate(
+                params, jnp.asarray(np.asarray(prompt)[None]), cfg,
+                max_new_tokens=m))[0]
+            return [int(t) for t in full[len(prompt):]]
+
+        clients = [KVReplicaClient(r, kv) for r in range(4)]
+        assert [c.pool for c in clients] == \
+            ["prefill", "prefill", "decode", "decode"], \
+            [c.pool for c in clients]
+        router = DisaggRouter(clients, kv,
+                              DisaggRouterConfig(max_attempts=6))
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(0, 256, size=(8 + 2 * i,)).astype(np.int32)
+                   for i in range(4)]
+        streamed: dict[int, list] = {}
+        futs = [router.submit(
+            p, 16,
+            stream_cb=lambda fid, t: streamed.setdefault(
+                fid, []).append(t)) for p in prompts]
+
+        # Drain by hand so the decode-pool health gauge is sampled on
+        # every pump — "never drops" is an acceptance criterion, not
+        # just the final value.
+        decode_gauge = REGISTRY.get("hvd_disagg_pool_replicas")
+        min_decode = float("inf")
+        drain_deadline = time.monotonic() + 240.0
+        while router._flights:
+            router.pump()
+            g = decode_gauge.labels(pool="decode").value
+            min_decode = min(min_decode, g)
+            if not router._flights:
+                break
+            if time.monotonic() > drain_deadline:
+                raise AssertionError(
+                    f"disagg drain stuck: "
+                    f"{[(f.fid, f.state) for f in router._flights.values()]}")
+            time.sleep(0.05)
+
+        for i, (p, f) in enumerate(zip(prompts, futs)):
+            res = f.result(timeout=5)
+            want = oracle(p, 16)
+            assert res.tokens == want, (i, res.tokens, want)
+            assert res.metrics["migrated"] is True, (i, res.metrics)
+            assert res.metrics["finish_reason"] == "length", res.metrics
+            # Exactly-once streaming under replay.
+            assert streamed.get(i, []) == want, (i, streamed.get(i), want)
+        assert router.failovers >= 1, \
+            "the mid-migration death never forced a failover"
+        assert min_decode >= 2.0, \
+            f"decode pool dipped to {min_decode} after a PREFILL kill"
+
+        kv.set("fd/stop", b"1")
+        assert workers[0].wait(timeout=30) == DIE_EXIT_CODE, \
+            workers[0].returncode
+        for w in workers[1:]:
+            assert w.wait(timeout=30) == 0, w.returncode
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+        kv.close()
+    print(f"CHAOS-DISAGG-OK np=4 (2 prefill + 2 decode) "
+          f"failovers={router.failovers} min_decode_pool={min_decode:.0f} "
+          f"(mid-migration prefill kill, token-identical completion)")
+
+
+# ---------------------------------------------------------------------------
 # scenario: determinism (same seed => identical fault sequence)
 # ---------------------------------------------------------------------------
 
@@ -676,9 +854,12 @@ def main(argv=None) -> int:
     p.add_argument("--router-worker", type=int, default=None,
                    metavar="RANK",
                    help=argparse.SUPPRESS)   # internal router replica
+    p.add_argument("--disagg-worker", nargs=2, default=None,
+                   metavar=("RANK", "POOL"),
+                   help=argparse.SUPPRESS)   # internal disagg replica
     p.add_argument("--scenario", default="all",
                    choices=("all", "elastic", "serving", "determinism",
-                            "router", "autoscale"))
+                            "router", "autoscale", "disagg"))
     p.add_argument("--np", type=int, default=4, dest="np_total")
     p.add_argument("--verbose", "-v", action="store_true")
     args = p.parse_args(argv)
@@ -688,6 +869,14 @@ def main(argv=None) -> int:
         return moe_worker_main()
     if args.router_worker is not None:
         return router_worker_main(args.router_worker)
+    if args.disagg_worker is not None:
+        return disagg_worker_main(int(args.disagg_worker[0]),
+                                  args.disagg_worker[1])
+
+    if args.scenario == "disagg":
+        # Not in "all": four full serving replicas (the dedicated
+        # disagg-recovery CI job runs it; chaos-recovery stays cheap).
+        scenario_disagg()
 
     if args.scenario == "router":
         # Not in "all": needs two full serving replicas (the dedicated

@@ -61,6 +61,11 @@ KNOWN_SITES = {
     "serving_step": "serving.engine.step (one per serving round)",
     "router": "serving.frontdoor.router placement (one traversal per "
               "placement decision)",
+    "mig_export": "serving.disagg.transport.publish_migration — one "
+                  "traversal per published blob (K, V, manifest), so "
+                  "after=N lands mid-migration",
+    "mig_import": "serving.disagg.transport.fetch_migration — one "
+                  "traversal per fetched blob",
 }
 
 _DUR_RE = re.compile(r"^([0-9]*\.?[0-9]+)(ms|s|m)?$")

@@ -302,7 +302,8 @@ def _arm_obs_plane() -> None:
             obs_registry.gauge(
                 "hvd_autoscale_target_np",
                 "world size the autoscale policy currently wants",
-            ).set(int(_target))
+                ("pool",),
+            ).labels(pool="all").set(int(_target))
         except ValueError:
             pass
     obs_aggregate.start_for_rank(jax.process_index(), jax.process_count())

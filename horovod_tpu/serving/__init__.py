@@ -25,6 +25,11 @@ with request-level scheduling:
   top of one-replica sessions: a multi-replica router over the obs
   plane's KV-store signals, a radix prefix cache that lets shared prompt
   prefixes skip prefill, and draft-model speculative decoding.
+- :mod:`~horovod_tpu.serving.disagg` — disaggregated prefill/decode:
+  pool-tagged replicas, cross-replica KV-block migration over the job
+  KV store (versioned manifest + chunked payloads, one shared retry
+  deadline), and a pool-aware router whose migration handoff is
+  first-class state with durable-point failover.
 
 The split follows HiCCL's policy/transport separation (arXiv:2408.05962):
 the scheduler decides *what* runs each step, the engine owns *how* it runs

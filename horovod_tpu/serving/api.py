@@ -110,16 +110,22 @@ class ServingSession:
     # -- client surface --------------------------------------------------
     def submit(self, prompt: Sequence[int], max_tokens: int, *,
                eos_token: Optional[int] = None,
-               stream_cb: Optional[Callable[[int, int], None]] = None
+               stream_cb: Optional[Callable[[int, int], None]] = None,
+               migrate_cb: Optional[Callable] = None
                ) -> Future:
         """Queue a request; the future resolves to a
         :class:`RequestResult`.  ``stream_cb(req_id, token)`` fires once
-        per generated token, in order."""
+        per generated token, in order.  ``migrate_cb`` makes this a
+        prefill-only request (disaggregated serving): the future
+        resolves after the prefill emission with
+        ``finish_reason="migrated"`` and the callback receives the
+        exported KV — see :mod:`horovod_tpu.serving.disagg`."""
         fut: Future = Future()
         with self._lock:
             req = self.engine.submit(prompt, max_tokens,
                                      eos_token=eos_token,
-                                     stream_cb=stream_cb)
+                                     stream_cb=stream_cb,
+                                     migrate_cb=migrate_cb)
             self._futures[req.req_id] = fut
             if req.trace.sampled:
                 self._trace_ids[req.req_id] = req.trace.trace_id
@@ -129,6 +135,26 @@ class ServingSession:
                 from ..obs import trace as _trace
                 while len(self._trace_ids) > _trace.TRACER.keep:
                     self._trace_ids.pop(next(iter(self._trace_ids)))
+        return fut
+
+    def import_migrated(self, manifest: dict, k_bytes: bytes,
+                        v_bytes: bytes, *,
+                        stream_cb: Optional[Callable[[int, int], None]]
+                        = None) -> Future:
+        """Resume a migrated request on this (decode-pool) session: the
+        exported KV blocks attach to the local pool with zero
+        re-prefill and the request joins the running decode batch.  The
+        future resolves to the FULL generated continuation (the
+        prefill-emitted token plus every decode token).  Raises
+        ``OutOfBlocks``/``ValueError`` when this engine cannot take the
+        request right now — the router retries another replica."""
+        fut: Future = Future()
+        with self._lock:
+            req = self.engine.import_migrated(manifest, k_bytes, v_bytes,
+                                              stream_cb=stream_cb)
+            self._futures[req.req_id] = fut
+            if req.trace.sampled:
+                self._trace_ids[req.req_id] = req.trace.trace_id
         return fut
 
     def request_trace(self, req_id: int) -> Optional[dict]:

@@ -52,6 +52,11 @@ _m_ready = _obs.gauge(
     "hvd_replica_ready",
     "this replica accepts new placements (serving component healthy); "
     "published to the router through the rank's obs snapshot")
+_m_pool_info = _obs.gauge(
+    "hvd_serving_pool_info",
+    "pool this replica serves (value 1; the pool is the label) — merged "
+    "cluster snapshots add the rank label, giving the autoscaler its "
+    "rank->pool map", ("pool",))
 _m_served = _obs.counter(
     "hvd_replica_requests_served_total",
     "requests this replica completed for the router")
@@ -120,15 +125,22 @@ def signals_from_snapshot(snap: dict) -> dict:
     if burn_fam:
         burn = max((float(s["value"]) for s in burn_fam["samples"]),
                    default=0.0)
+    pool = None
+    pool_fam = fams.get("hvd_serving_pool_info")
+    if pool_fam and pool_fam.get("samples"):
+        pool = pool_fam["samples"][0].get("labels", {}).get("pool")
     return {
         "rank": int(snap.get("rank", -1)),
         "alive": True,
         "stale": snapshot_is_stale(snap),
         "ready": gauge("hvd_replica_ready") >= 1.0,
+        "pool": pool,
         "queue_depth": gauge("hvd_serving_queue_depth"),
         "occupancy": gauge("hvd_serving_batch_occupancy"),
         "ttft_p99": _hist_quantile(
             fams.get("hvd_serving_ttft_seconds"), 0.99),
+        "itl_p99": _hist_quantile(
+            fams.get("hvd_serving_itl_seconds"), 0.99),
         "slo_burn": burn,
         "time": float(snap.get("time", 0.0)),
     }
@@ -136,8 +148,9 @@ def signals_from_snapshot(snap: dict) -> dict:
 
 #: the signal record for a replica the router cannot see at all
 DEAD_SIGNALS = {"alive": False, "stale": True, "ready": False,
-                "queue_depth": float("inf"), "occupancy": 1.0,
-                "ttft_p99": None, "slo_burn": 0.0}
+                "pool": None, "queue_depth": float("inf"),
+                "occupancy": 1.0, "ttft_p99": None, "itl_p99": None,
+                "slo_burn": 0.0}
 
 
 # ---------------------------------------------------------------------------
@@ -154,7 +167,8 @@ class ReplicaServer:
 
     def __init__(self, session, rank: int, *,
                  kv_factory: Callable = _kv_from_env,
-                 poll_interval_s: float = 0.05) -> None:
+                 poll_interval_s: float = 0.05,
+                 pool: Optional[str] = None) -> None:
         kv = kv_factory()
         if kv is None:
             raise RuntimeError(
@@ -164,6 +178,10 @@ class ReplicaServer:
         self._kv_lock = threading.Lock()
         self.session = session
         self.rank = int(rank)
+        #: which pool this replica serves (disaggregated serving):
+        #: "prefill", "decode", or "mixed" (the default — eligible for
+        #: everything, the pre-disagg behavior).
+        self.pool = pool or os.environ.get("HVDTPU_SERVING_POOL", "mixed")
         self._poll = poll_interval_s
         self._seq = 0
         self._stop = threading.Event()
@@ -173,7 +191,8 @@ class ReplicaServer:
 
     def register(self) -> None:
         rec = {"rank": self.rank, "pid": os.getpid(),
-               "time": time.time()}
+               "pool": self.pool, "time": time.time()}
+        _m_pool_info.labels(pool=self.pool).set(1.0)
         with self._kv_lock:
             self._kv.set(f"{MEMBER_PREFIX}{self.rank}",
                          json.dumps(rec).encode())
@@ -238,12 +257,73 @@ class ReplicaServer:
             except (ConnectionError, OSError, TimeoutError):
                 pass             # progress is best-effort; results are not
 
-        fut = self.session.submit(
-            payload["prompt"], payload["max_tokens"],
-            eos_token=payload.get("eos_token"), stream_cb=on_token)
-        fut.add_done_callback(lambda f: self._publish_result(seq, f))
+        mode = payload.get("mode", "generate")
+        extra = {}
+        try:
+            if mode == "generate":
+                fut = self.session.submit(
+                    payload["prompt"], payload["max_tokens"],
+                    eos_token=payload.get("eos_token"),
+                    stream_cb=on_token)
+            elif mode == "prefill_export":
+                # Prefill-pool leg of a disaggregated request: run the
+                # prefill, export the KV blocks, publish them under the
+                # router-assigned migration id, and resolve with
+                # finish_reason="migrated".
+                from ..disagg import transport as mig_transport
+                mig_id = payload["mig_id"]
+                extra["mig_id"] = mig_id
 
-    def _publish_result(self, seq: int, fut) -> None:
+                def publish(manifest, k_bytes, v_bytes):
+                    with self._kv_lock:
+                        mig_transport.publish_migration(
+                            self._kv, mig_id, manifest, k_bytes, v_bytes)
+
+                fut = self.session.submit(
+                    payload["prompt"], payload["max_tokens"],
+                    eos_token=payload.get("eos_token"),
+                    stream_cb=on_token, migrate_cb=publish)
+            elif mode == "decode_import":
+                # Decode-pool leg: fetch the migrated blocks, attach
+                # them to the local pool, resume decoding.  The
+                # progress stream is seeded with the tokens the prefill
+                # replica already emitted.
+                from ..disagg import transport as mig_transport
+                mig_id = payload["mig_id"]
+                with self._kv_lock:
+                    manifest, k_bytes, v_bytes = \
+                        mig_transport.fetch_migration(
+                            self._kv, mig_id,
+                            timeout_ms=int(payload.get(
+                                "fetch_timeout_ms", 15000)))
+                tokens.extend(int(t) for t in manifest["generated"])
+                with self._kv_lock:
+                    self._kv.set(prog_key, json.dumps(tokens).encode())
+                fut = self.session.import_migrated(
+                    manifest, k_bytes, v_bytes, stream_cb=on_token)
+            else:
+                raise ValueError(f"unknown request mode {mode!r}")
+        except Exception as e:
+            self._publish_error(seq, e, extra)
+            return
+        fut.add_done_callback(
+            lambda f: self._publish_result(seq, f, extra))
+
+    def _publish_error(self, seq: int, exc: Exception,
+                       extra: Optional[dict] = None) -> None:
+        out = {"ok": False, "error": str(exc),
+               "error_kind": type(exc).__name__}
+        out.update(extra or {})
+        from ...runner.api import kv_put_blob
+        try:
+            with self._kv_lock:
+                kv_put_blob(self._kv, f"{RES_PREFIX}{self.rank}/{seq}",
+                            json.dumps(out).encode())
+        except (ConnectionError, OSError, TimeoutError):
+            pass
+
+    def _publish_result(self, seq: int, fut,
+                        extra: Optional[dict] = None) -> None:
         from ...runner.api import kv_put_blob
         try:
             res = fut.result()
@@ -251,7 +331,9 @@ class ReplicaServer:
                    "finish_reason": res.metrics.get("finish_reason"),
                    "metrics": res.metrics}
         except Exception as e:               # replica-side failure
-            out = {"ok": False, "error": str(e)}
+            out = {"ok": False, "error": str(e),
+                   "error_kind": type(e).__name__}
+        out.update(extra or {})
         _m_served.inc()
         try:
             with self._kv_lock:
@@ -281,6 +363,22 @@ class KVReplicaClient:
                 "KVReplicaClient needs the job KV store "
                 "(HVDTPU_RENDEZVOUS_ADDR unset?)")
         self._seq = 0          # single-router assumption (module doc)
+        self._pool: Optional[str] = None
+
+    @property
+    def pool(self) -> str:
+        """Pool tag from the replica's published membership record
+        ("mixed" until the record is visible); cached after first
+        read — a replica's pool does not change within a job."""
+        if self._pool is None:
+            try:
+                raw = self._kv.get(f"{MEMBER_PREFIX}{self.rank}")
+                if raw is not None:
+                    self._pool = json.loads(raw.decode()).get(
+                        "pool", "mixed")
+            except (ConnectionError, OSError, TimeoutError, ValueError):
+                pass
+        return self._pool or "mixed"
 
     def drive(self) -> None:
         """Remote replicas step themselves."""
@@ -300,12 +398,35 @@ class KVReplicaClient:
 
     def submit(self, prompt, max_tokens: int, *,
                eos_token: Optional[int] = None) -> int:
-        from ...runner.api import kv_put_blob
-        seq = self._seq
-        self._seq += 1
         payload = {"prompt": [int(t) for t in np.asarray(prompt)],
                    "max_tokens": int(max_tokens),
                    "eos_token": eos_token}
+        return self._submit_payload(payload)
+
+    def submit_prefill(self, prompt, max_tokens: int, *,
+                       eos_token: Optional[int] = None,
+                       mig_id: str) -> int:
+        """Disaggregated prefill leg: the replica prefills, publishes
+        the KV export under ``mig_id``, and resolves with
+        ``finish_reason="migrated"``."""
+        payload = {"prompt": [int(t) for t in np.asarray(prompt)],
+                   "max_tokens": int(max_tokens),
+                   "eos_token": eos_token,
+                   "mode": "prefill_export", "mig_id": str(mig_id)}
+        return self._submit_payload(payload)
+
+    def submit_import(self, mig_id: str, *,
+                      fetch_timeout_ms: int = 15000) -> int:
+        """Disaggregated decode leg: the replica fetches the migration
+        blob, attaches it, and decodes to completion."""
+        return self._submit_payload(
+            {"mode": "decode_import", "mig_id": str(mig_id),
+             "fetch_timeout_ms": int(fetch_timeout_ms)})
+
+    def _submit_payload(self, payload: dict) -> int:
+        from ...runner.api import kv_put_blob
+        seq = self._seq
+        self._seq += 1
         kv_put_blob(self._kv, f"{REQ_PREFIX}{self.rank}/{seq}",
                     json.dumps(payload).encode())
         return seq

@@ -86,6 +86,12 @@ class Request:
     #: per-QUEUE-span wait is measured from here (t_submit would charge
     #: a preempted request's whole prior lifetime to queueing).
     t_enqueued: Optional[float] = None
+    #: disaggregated-serving hook: when set, the engine stops the request
+    #: after its prefill emission, exports its KV blocks, and calls
+    #: ``migrate_cb(manifest, k_bytes, v_bytes)`` — the request finishes
+    #: locally with ``finish_reason="migrated"`` and a decode-pool
+    #: replica continues it (see serving/disagg).  None = normal serving.
+    migrate_cb: Optional[Callable] = None
     #: request-scoped trace (obs/trace): the root span of this request's
     #: causal chain (NULL_SPAN when unsampled/untraced) plus the open
     #: phase spans, keyed "queue"/"prefill"/"decode"; "prev" holds the

@@ -231,6 +231,72 @@ def test_signals_empty_snapshot_is_stale():
     assert s.signal_age_s == float("inf")
 
 
+def _two_pool_fams():
+    """Synthetic merged snapshot of a disaggregated fleet: the prefill
+    rank is drowning (deep queue, hot SLO burn) while the decode rank
+    idles."""
+    return [
+        _fam("horovod_tpu_rank_snapshot_age_seconds",
+             ({"rank": "0"}, 1.0), ({"rank": "1"}, 1.0)),
+        _fam("hvd_serving_pool_info",
+             ({"rank": "0", "pool": "prefill"}, 1.0),
+             ({"rank": "1", "pool": "decode"}, 1.0)),
+        _fam("hvd_serving_queue_depth",
+             ({"rank": "0"}, 40.0), ({"rank": "1"}, 0.0)),
+        _fam("hvd_slo_burn_rate",
+             ({"rank": "0", "slo": "ttft_p99", "window": "5m"}, 25.0),
+             ({"rank": "0", "slo": "ttft_p99", "window": "1h"}, 12.0),
+             ({"rank": "1", "slo": "itl_p99", "window": "5m"}, 0.2),
+             ({"rank": "1", "slo": "itl_p99", "window": "1h"}, 0.1)),
+    ]
+
+
+def test_signals_pool_filter_splits_the_fleet():
+    fams = _two_pool_fams()
+    pre = signals_from_families(fams, current_np=1, available_slots=4,
+                                pool="prefill")
+    dec = signals_from_families(fams, current_np=1, available_slots=4,
+                                pool="decode")
+    assert pre.queue_depth == 40.0 and pre.burn_fast == 25.0
+    assert dec.queue_depth == 0.0 and dec.burn_fast == 0.2
+    # An unknown pool sees nobody -> infinitely stale, policy holds.
+    ghost = signals_from_families(fams, current_np=1, available_slots=4,
+                                  pool="mixed")
+    assert ghost.signal_age_s == float("inf")
+
+
+def test_prefill_burn_cannot_grow_decode_pool():
+    """The isolation regression: with pool filtering, the prefill rank's
+    queue/burn storm grows only a prefill-pool controller's target —
+    a decode-pool policy fed the same snapshot holds."""
+    fams = _two_pool_fams()
+    pre_sig = signals_from_families(fams, current_np=1, available_slots=4,
+                                    pool="prefill")
+    dec_sig = signals_from_families(fams, current_np=1, available_slots=4,
+                                    pool="decode")
+    p_pre, _ = _policy(min_np=1, queue_low=1.0, queue_high=8.0)
+    p_dec, _ = _policy(min_np=1, queue_low=0.0, queue_high=8.0)
+    d_pre = p_pre.decide(pre_sig)
+    d_dec = p_dec.decide(dec_sig)
+    assert d_pre.action == "grow" and d_pre.target_np > 1, d_pre
+    assert d_dec.action == "hold", d_dec
+    # Without the filter the decode view inherits the prefill queue —
+    # the bug this guards against.
+    mixed = signals_from_families(fams, current_np=1, available_slots=4)
+    assert mixed.queue_depth == 40.0
+
+
+def test_controller_target_gauge_is_pool_labeled():
+    from horovod_tpu.autoscale import controller as ctl
+    p, _ = _policy()
+    c = AutoscaleController(
+        p, current_np=2, collect=lambda: [], bump=lambda: None,
+        capacity=lambda: 4, pool="decode")
+    c._m_target.set(2.0)
+    labels = [s["labels"] for s in ctl._m_target._samples()]
+    assert {"pool": "decode"} in labels, labels
+
+
 # ---------------------------------------------------------------------------
 # controller: record + act (no thread, no sleeps)
 # ---------------------------------------------------------------------------

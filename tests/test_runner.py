@@ -597,3 +597,26 @@ def test_autoscale_recovery_scenario_harness():
     assert res.returncode == 0, res.stdout + res.stderr
     assert "CHAOS-AUTOSCALE-OK" in res.stdout, res.stdout
     assert "CHAOS-OK" in res.stdout, res.stdout
+
+
+@pytest.mark.integration
+@pytest.mark.slow
+def test_disagg_recovery_scenario_harness():
+    """Acceptance (the disagg-recovery CI job, wrapped): np=4 replica
+    workers pool-tagged 2 prefill + 2 decode behind the DisaggRouter,
+    an injected mig_export death kills a prefill replica mid-migration
+    (K chunk published, manifest not), and every request completes
+    token-identical via durable-point replay on the pool sibling while
+    the decode pool's eligibility gauge never dips.  slow-marked: four
+    full serving-worker startups."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("HVDTPU_FAULTS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.chaos.run",
+         "--scenario", "disagg"],
+        capture_output=True, text=True, timeout=480, env=env, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "CHAOS-DISAGG-OK" in res.stdout, res.stdout
+    assert "CHAOS-OK" in res.stdout, res.stdout
