@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: ci native test mp-test examples bench baseline-table image \
 	autoscale-recovery disagg-recovery perf-regress bench-trajectory \
-	hierarchical-parity compiled-parity zero1-parity
+	hierarchical-parity compiled-parity zero1-parity trace
 
 # The autoscale-recovery CI job standalone: np=4 MoE job, injected rank
 # death + SLO load spike => shrink to np=2, grow back to np=4.
@@ -15,9 +15,21 @@ autoscale-recovery:
 
 # The disagg-recovery CI job standalone: np=4 (2 prefill + 2 decode
 # pools), injected prefill-replica death mid-migration => durable-point
-# replay, token-identical completion, decode pool never dips.
+# replay, token-identical completion, decode pool never dips, and one
+# /tracez pull whose merged Perfetto JSON (uploaded as an artifact)
+# shows the killed-replica request as one connected cross-process chain.
 disagg-recovery:
 	$(PY) -m horovod_tpu.chaos.run --scenario disagg
+
+# Pull the fleet trace from a running job's /tracez endpoint into ONE
+# Perfetto-loadable file (clock-aligned, cross-process flow arrows,
+# critical-path report embedded under "report").
+#   make trace TRACE_URL=http://host:9464 TRACE_OUT=/tmp/fleet.json
+TRACE_URL ?= http://127.0.0.1:9464
+TRACE_OUT ?= /tmp/hvdtpu_fleet_trace.json
+trace:
+	$(PY) -m horovod_tpu.obs.tracemerge fetch $(TRACE_URL) \
+		-o $(TRACE_OUT) --report
 
 ci: native
 	$(PY) -c "import horovod_tpu, horovod_tpu.torch, horovod_tpu.tensorflow, \

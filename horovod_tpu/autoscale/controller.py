@@ -116,6 +116,7 @@ def signals_from_families(families: list, *, current_np: int,
     queue = 0.0
     stragglers: set = set()
     burn_fast = burn_slow = 0.0
+    crit_by_rank: dict[str, float] = {}
     for fam in families:
         name = fam.get("name")
         if name in ("hvd_engine_queue_depth", "hvd_serving_queue_depth"):
@@ -125,6 +126,16 @@ def signals_from_families(families: list, *, current_np: int,
             for s in fresh_samples(fam):
                 if float(s.get("value", 0.0)) > 0:
                     stragglers.add(s.get("labels", {}).get("rank"))
+        elif name == "hvd_trace_critical_phase_seconds":
+            # Critical-path attribution from the fleet trace plane: the
+            # per-(phase, rank) self seconds of recently merged traces.
+            # The label the gauge is keyed on is the rank the time was
+            # SPENT on, so sum per rank.
+            for s in fresh_samples(fam):
+                r = s.get("labels", {}).get("rank")
+                if r is not None:
+                    crit_by_rank[str(r)] = (crit_by_rank.get(str(r), 0.0)
+                                            + float(s.get("value", 0.0)))
         elif name == "hvd_slo_burn_rate":
             for s in fresh_samples(fam):
                 win = s.get("labels", {}).get("window")
@@ -133,6 +144,14 @@ def signals_from_families(families: list, *, current_np: int,
                     burn_fast = max(burn_fast, v)
                 elif win == "1h":
                     burn_slow = max(burn_slow, v)
+    # A rank that owns the majority of the fleet's critical-path time is
+    # a straggler whether or not the per-rank cycle gauge flagged it —
+    # trace attribution sees cross-process waits the local view can't.
+    total_crit = sum(crit_by_rank.values())
+    if total_crit > 0 and len(crit_by_rank) > 1:
+        for r, v in crit_by_rank.items():
+            if v > 0.5 * total_crit:
+                stragglers.add(r)
     return Signals(current_np=current_np, available_slots=available_slots,
                    queue_depth=queue, stragglers=len(stragglers),
                    burn_fast=burn_fast, burn_slow=burn_slow,

@@ -651,6 +651,7 @@ def disagg_worker_main(rank: int, pool: str) -> int:
     from ..models import llama
     from ..obs import flightrec
     from ..obs.aggregate import RankPublisher, _kv_from_env
+    from ..obs.tracemerge import TracePublisher
     from ..serving.frontdoor.transport import ReplicaServer
 
     flightrec.RECORDER.arm(os.environ.get("HVDTPU_FLIGHT_RECORDER_DIR"))
@@ -666,11 +667,17 @@ def disagg_worker_main(rank: int, pool: str) -> int:
     # and a transiently-late DECODE publish must not read as a pool dip
     # when the fault targets a PREFILL rank.
     pub = RankPublisher(rank, 4, interval_s=2.0).start()
+    # Fleet trace plane: publish ended spans + answer clock pings so the
+    # parent's /tracez shows the migrated request as one connected
+    # chain across processes.  1s cadence keeps the post-recovery pull
+    # short.
+    tpub = TracePublisher(rank, pool=pool, interval_s=1.0).start()
     sess.start()
     try:
         while kv.get("fd/stop") is None:
             time.sleep(0.1)
     finally:
+        tpub.stop()
         pub.stop()
         replica.stop()
         sess.close()
@@ -685,7 +692,15 @@ def scenario_disagg() -> None:
     the migration path (``metrics["migrated"]``), the router recorded
     the prefill-stage failover, ``hvd_disagg_pool_replicas{pool=
     "decode"}`` never dropped below 2 (decode pool untouched by a
-    prefill kill), and the victim exited with ``DIE_EXIT_CODE``."""
+    prefill kill), and the victim exited with ``DIE_EXIT_CODE``.
+
+    After recovery, one ``/tracez`` pull (served from this router
+    process over the workers' TracePublishers) must yield a single
+    Perfetto-loadable JSON — written as the ``disagg_tracez.json``
+    artifact — in which a migrated request is ONE connected trace_id
+    spanning >= 3 processes, with cross-process flow arrows,
+    per-lane-monotonic timestamps, and a critical-path report naming
+    the dominant phase and rank."""
     import secrets
     import subprocess
 
@@ -796,6 +811,66 @@ def scenario_disagg() -> None:
         assert min_decode >= 2.0, \
             f"decode pool dipped to {min_decode} after a PREFILL kill"
 
+        # Post-recovery fleet trace: serve /tracez from this (router)
+        # process, pull it once over HTTP, and assert the merged
+        # Perfetto view shows a migrated request as ONE connected
+        # trace_id spanning router + prefill + decode processes with
+        # cross-process flow arrows and per-lane-monotonic spans.
+        import urllib.request
+        from collections import defaultdict
+        from ..obs import server as obs_server
+        from ..obs.tracemerge import TraceCollector
+        collector = TraceCollector(
+            own_rank=4, own_pool="router",
+            kv_factory=lambda: KvClient("127.0.0.1", kv_srv.port,
+                                        timeout_ms=5000))
+        obs_server.set_trace_provider(collector.collect)
+        srv = obs_server.MetricsServer(0, addr="127.0.0.1")
+        try:
+            merged, chain_tid = None, None
+            trace_deadline = time.monotonic() + 30.0
+            while time.monotonic() < trace_deadline:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/tracez",
+                        timeout=10) as resp:
+                    merged = json.loads(resp.read().decode())
+                by_tid = defaultdict(set)
+                for ev in merged["traceEvents"]:
+                    if ev.get("ph") == "X" and \
+                            ev.get("args", {}).get("trace_id"):
+                        by_tid[ev["args"]["trace_id"]].add(ev["pid"])
+                spanning = [t for t, pids in by_tid.items()
+                            if len(pids) >= 3]
+                if spanning:
+                    chain_tid = spanning[0]
+                    break
+                time.sleep(0.5)     # worker publishers on a 1s cadence
+            assert chain_tid is not None, \
+                "no trace spans >= 3 processes in the merged /tracez view"
+            flows = [ev for ev in merged["traceEvents"]
+                     if ev.get("cat") == "trace"
+                     and ev.get("ph") in ("s", "f")]
+            assert flows, "merged trace has no cross-process flow arrows"
+            lanes = defaultdict(list)
+            for ev in merged["traceEvents"]:
+                if ev.get("ph") == "X":
+                    lanes[(ev["pid"], ev["tid"])].append(ev["ts"])
+            assert all(ts == sorted(ts) for ts in lanes.values()), \
+                "merged trace is not monotonic per lane"
+            report = merged.get("report", {})
+            assert report.get("dominant_phase") is not None \
+                and report.get("dominant_rank") is not None, report
+            artifact = os.environ.get(
+                "HVDTPU_TRACE_ARTIFACT",
+                os.path.join(env_base["HVDTPU_FLIGHT_RECORDER_DIR"],
+                             "disagg_tracez.json"))
+            with open(artifact, "w") as fh:
+                json.dump(merged, fh)
+        finally:
+            obs_server.set_trace_provider(None)
+            collector.close()
+            srv.close()
+
         kv.set("fd/stop", b"1")
         assert workers[0].wait(timeout=30) == DIE_EXIT_CODE, \
             workers[0].returncode
@@ -808,7 +883,9 @@ def scenario_disagg() -> None:
         kv.close()
     print(f"CHAOS-DISAGG-OK np=4 (2 prefill + 2 decode) "
           f"failovers={router.failovers} min_decode_pool={min_decode:.0f} "
-          f"(mid-migration prefill kill, token-identical completion)")
+          f"(mid-migration prefill kill, token-identical completion; "
+          f"/tracez chain {chain_tid} spans "
+          f"{len(by_tid[chain_tid])} processes -> {artifact})")
 
 
 # ---------------------------------------------------------------------------

@@ -196,10 +196,17 @@ def init(
         _state.devices = devs
         _state.mesh = Mesh(np.array(devs), axis_names=(cfg.dp_axis_name,))
 
-        from .utils.timeline import Timeline
+        from .utils.timeline import Timeline, rank_suffixed
         # rank stamps the clock_sync merge anchor so `timeline merge`
         # can rebase per-rank files onto one axis without filename hints.
-        _state.timeline = Timeline(cfg.timeline,
+        # np>1 additionally suffixes the path per rank (`/path.r3.json`)
+        # — co-hosted workers handed one HOROVOD_TIMELINE path must not
+        # clobber each other's traces; np=1 keeps the bare path.
+        tl_path = cfg.timeline
+        if tl_path:
+            tl_path = rank_suffixed(tl_path, jax.process_index(),
+                                    jax.process_count())
+        _state.timeline = Timeline(tl_path,
                                    mark_cycles=cfg.timeline_mark_cycles,
                                    rank=jax.process_index())
 
@@ -312,6 +319,16 @@ def _arm_obs_plane() -> None:
     # (it already folded the env surface in).
     obs_trace.TRACER.sample_rate = cfg.trace_sample
 
+    # Fleet trace plane: every rank publishes its ended-span table (and
+    # timeline tail, when one is armed) + answers clock pings; /tracez
+    # serves the merged Perfetto view (rank 0 is the canonical target,
+    # mirroring /cluster).
+    from .obs import tracemerge as obs_tracemerge
+    obs_tracemerge.start_for_rank(
+        jax.process_index(), jax.process_count(),
+        pool=os.environ.get("HVDTPU_SERVING_POOL"),
+        timeline_path=getattr(_state.timeline, "_path", None))
+
     # Flight recorder: identity for bundle headers; arming enables the
     # engine/elastic auto-dumps and the crash excepthook.
     obs_flightrec.RECORDER.set_identity(jax.process_index(),
@@ -418,7 +435,9 @@ def shutdown() -> None:
         from .obs import prof as obs_prof
         from .obs import server as obs_server
         from .obs import slo as obs_slo
+        from .obs import tracemerge as obs_tracemerge
         obs_aggregate.stop()
+        obs_tracemerge.stop()
         obs_slo.disarm()
         # Symmetric with the arm in init(): the sampler belongs to the
         # library lifecycle, not the process.

@@ -64,6 +64,20 @@ def set_health_provider(fn) -> None:
         _health_provider = fn
 
 
+_trace_provider = None
+_trace_lock = threading.Lock()
+
+
+def set_trace_provider(fn) -> None:
+    """Register (or clear) the callable behind ``GET /tracez``: the
+    fleet trace collector (:mod:`horovod_tpu.obs.tracemerge`), whose
+    result is one clock-aligned Perfetto-loadable JSON object.  Armed
+    by ``hvd.init()`` next to the cluster provider."""
+    global _trace_provider
+    with _trace_lock:
+        _trace_provider = fn
+
+
 def _make_handler(registry: MetricRegistry):
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - http.server API
@@ -111,6 +125,21 @@ def _make_handler(registry: MetricRegistry):
                 else:
                     body = export.to_json(snap)
                     ctype = "application/json"
+            elif path in ("/tracez", "/tracez.json"):
+                with _trace_lock:
+                    provider = _trace_provider
+                if provider is None:
+                    self.send_error(
+                        503, "fleet trace collection not armed on this "
+                             "process (hvd.init() arms it; per-process "
+                             "traces stay in the tracer's export)")
+                    return
+                try:
+                    merged = provider()
+                except Exception as e:   # scrape must answer, not 500
+                    merged = {"traceEvents": [], "error": str(e)}
+                body = json.dumps(merged)
+                ctype = "application/json"
             elif path in ("/profz", "/profz.json"):
                 from .prof import PROFILER
                 if path == "/profz":
@@ -122,7 +151,8 @@ def _make_handler(registry: MetricRegistry):
             else:
                 self.send_error(
                     404, "try /metrics, /metrics.json, /cluster, "
-                         "/cluster.json, /profz, /profz.json or /healthz")
+                         "/cluster.json, /tracez, /profz, /profz.json "
+                         "or /healthz")
                 return
             payload = body.encode("utf-8")
             self.send_response(200)

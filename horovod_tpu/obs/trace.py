@@ -26,7 +26,17 @@ missing causal layer:
 - tracing is **sampled**: ``HOROVOD_TPU_TRACE_SAMPLE`` (0.0–1.0, default
   1.0) decides per trace at :meth:`Tracer.start_trace`; an unsampled
   trace costs one comparison — every span call on it is a no-op on the
-  shared :data:`NULL_SPAN`.
+  shared :data:`NULL_SPAN`;
+- traces **cross process boundaries**: :meth:`Span.context` serializes
+  the ``(trace_id, span_id, sampled)`` triple as a plain dict that rides
+  any transport (frontdoor request payloads, the disagg migration
+  manifest), and ``start_trace(parent=ctx)`` adopts it on the far side —
+  same ``trace_id``, root parented under the remote span, and the
+  ingress sampling decision honored verbatim (``sampled=False`` short-
+  circuits to :data:`NULL_SPAN` with no local re-roll).  Span ids carry
+  a per-process random salt so they stay unique fleet-wide, which is
+  what lets the merged view (:mod:`horovod_tpu.obs.tracemerge`) stitch
+  cross-process flow arrows by ``(trace_id, span_id)`` alone.
 
 Stdlib-only, importable before (and without) jax, like the rest of
 ``obs``.
@@ -111,13 +121,13 @@ class Span:
     as one connected chain."""
 
     __slots__ = ("_st", "span_id", "parent_id", "name", "t0", "t1",
-                 "attrs", "events", "_after", "_ctx_token")
+                 "attrs", "events", "_after", "_ctx_token", "_root")
 
     def __init__(self, st: _TraceState, name: str,
                  parent_id: Optional[str], after: Optional["Span"] = None,
                  **attrs: Any) -> None:
         self._st = st
-        self.span_id = f"{st.tracer._next_id():x}"
+        self.span_id = f"{st.tracer._salt}-{st.tracer._next_id():x}"
         self.parent_id = parent_id
         self.name = name
         self.t0 = time.monotonic()
@@ -126,6 +136,7 @@ class Span:
         self.events: list = []
         self._after = after
         self._ctx_token = None
+        self._root = False
 
     # -- identity ---------------------------------------------------------
     @property
@@ -135,6 +146,15 @@ class Span:
     @property
     def sampled(self) -> bool:
         return True
+
+    def context(self) -> dict:
+        """The wire-format trace context: a JSON-ready dict carrying the
+        ``(trace_id, span_id, sampled)`` triple.  Ship it in a request
+        payload or migration manifest and pass it to
+        ``start_trace(parent=...)`` on the receiving process."""
+        return {"trace_id": self._st.trace_id,
+                "span_id": self.span_id,
+                "sampled": True}
 
     # -- recording --------------------------------------------------------
     def set(self, **attrs: Any) -> "Span":
@@ -216,6 +236,10 @@ class _NullSpan:
     def set(self, **attrs):
         return self
 
+    def context(self) -> dict:
+        # The ingress said "don't sample"; downstream must honor it.
+        return {"sampled": False}
+
     def event(self, name, **attrs):
         pass
 
@@ -254,6 +278,23 @@ NULL_SPAN = _NullSpan()
 _NULL_CTX = _NullContext()
 
 
+def _coerce_context(parent) -> Optional[dict]:
+    """Normalize a ``parent=`` value to a context dict (or None).
+    Accepts a :class:`Span`/:data:`NULL_SPAN` (uses its ``context()``),
+    an already-serialized dict, or None.  Unrecognizable values are
+    treated as absent — a malformed manifest field must degrade to a
+    fresh local sampling decision, not a crash."""
+    if parent is None:
+        return None
+    ctx = getattr(parent, "context", None)
+    if callable(ctx):
+        try:
+            parent = ctx()
+        except Exception:
+            return None
+    return parent if isinstance(parent, dict) else None
+
+
 class Tracer:
     """Process-wide trace factory + bounded finished-trace table."""
 
@@ -271,6 +312,10 @@ class Tracer:
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
         self._rng = random.Random(os.urandom(8))
+        # Per-process salt on span ids: a trace that crosses processes
+        # holds spans minted by several tracers whose counters all start
+        # at 1, so bare counters would collide within one trace_id.
+        self._salt = f"{self._rng.getrandbits(24):06x}"
         self._finished: "OrderedDict[str, _TraceState]" = OrderedDict()
         self.last_trace_id: Optional[str] = None
 
@@ -289,23 +334,45 @@ class Tracer:
 
     # -- trace lifecycle --------------------------------------------------
     def start_trace(self, name: str, *, lane: Optional[str] = None,
-                    timeline=None, **attrs: Any):
+                    timeline=None, parent=None, **attrs: Any):
         """Root span of a new trace, or :data:`NULL_SPAN` when the
         sampling decision says no.  ``lane`` names the Timeline-v2 row
         the trace's spans render on (defaults to the trace id);
         ``timeline`` is the :class:`~horovod_tpu.utils.timeline.Timeline`
-        sink (None = no timeline emission, JSON/flight-recorder only)."""
-        if not self._should_sample():
-            _m_traces.labels(sampled="false").inc()
-            return NULL_SPAN
-        _m_traces.labels(sampled="true").inc()
-        with self._lock:
-            trace_id = f"{self._rng.getrandbits(64):016x}"
+        sink (None = no timeline emission, JSON/flight-recorder only).
+
+        ``parent`` joins an existing trace instead of opening a new one:
+        pass a :class:`Span` or a :meth:`Span.context` dict (possibly
+        deserialized on the far side of a transport).  The local root
+        adopts the parent's ``trace_id`` and is parented under the remote
+        ``span_id``; the parent's sampling decision is final — a
+        ``sampled=False`` context returns :data:`NULL_SPAN` without
+        consulting the local sample rate, so one ingress decision governs
+        the whole distributed chain."""
+        ctx = _coerce_context(parent)
+        if ctx is not None:
+            if not ctx.get("sampled") or not ctx.get("trace_id"):
+                _m_traces.labels(sampled="false").inc()
+                return NULL_SPAN
+            _m_traces.labels(sampled="true").inc()
+            trace_id = str(ctx["trace_id"])
+            parent_sid = ctx.get("span_id")
+            parent_sid = str(parent_sid) if parent_sid else None
+        else:
+            if not self._should_sample():
+                _m_traces.labels(sampled="false").inc()
+                return NULL_SPAN
+            _m_traces.labels(sampled="true").inc()
+            with self._lock:
+                trace_id = f"{self._rng.getrandbits(64):016x}"
+            parent_sid = None
         st = _TraceState(self, trace_id, name,
                          lane or f"trace:{trace_id[:8]}",
                          timeline if timeline is not None
                          and getattr(timeline, "enabled", False) else None)
-        return Span(st, name, None, **attrs)
+        sp = Span(st, name, parent_sid, **attrs)
+        sp._root = True
+        return sp
 
     def _span_ended(self, span: Span) -> None:
         st = span._st
@@ -347,7 +414,9 @@ class Tracer:
             **{k: v for k, v in span.attrs.items()
                if k not in reserved
                and isinstance(v, (int, float, str, bool))})
-        if span.parent_id is None:     # root ended -> trace finished
+        # Root ended -> trace finished.  An adopted root (remote parent)
+        # has a non-None parent_id, hence the explicit flag.
+        if span._root or span.parent_id is None:
             self._finish(st)
 
     def _finish(self, st: _TraceState) -> None:
@@ -376,9 +445,22 @@ class Tracer:
         return {
             "trace_id": st.trace_id,
             "name": st.name,
+            "lane": st.lane,
             "t_start_unix": round(st.t_wall0, 6),
             "spans": spans,
         }
+
+    def export_all(self) -> list:
+        """Every finished trace still in the bounded table, oldest first
+        — the per-rank publication unit for the fleet trace plane."""
+        with self._lock:
+            ids = list(self._finished)
+        out = []
+        for tid in ids:
+            d = self.export(tid)
+            if d is not None:
+                out.append(d)
+        return out
 
     def finished_ids(self) -> list:
         with self._lock:

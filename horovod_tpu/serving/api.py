@@ -111,7 +111,8 @@ class ServingSession:
     def submit(self, prompt: Sequence[int], max_tokens: int, *,
                eos_token: Optional[int] = None,
                stream_cb: Optional[Callable[[int, int], None]] = None,
-               migrate_cb: Optional[Callable] = None
+               migrate_cb: Optional[Callable] = None,
+               trace_ctx: Optional[dict] = None
                ) -> Future:
         """Queue a request; the future resolves to a
         :class:`RequestResult`.  ``stream_cb(req_id, token)`` fires once
@@ -119,13 +120,16 @@ class ServingSession:
         prefill-only request (disaggregated serving): the future
         resolves after the prefill emission with
         ``finish_reason="migrated"`` and the callback receives the
-        exported KV — see :mod:`horovod_tpu.serving.disagg`."""
+        exported KV — see :mod:`horovod_tpu.serving.disagg`.
+        ``trace_ctx`` joins an upstream trace (a router ingress span's
+        ``Span.context()`` dict, carried over the request transport)."""
         fut: Future = Future()
         with self._lock:
             req = self.engine.submit(prompt, max_tokens,
                                      eos_token=eos_token,
                                      stream_cb=stream_cb,
-                                     migrate_cb=migrate_cb)
+                                     migrate_cb=migrate_cb,
+                                     trace_ctx=trace_ctx)
             self._futures[req.req_id] = fut
             if req.trace.sampled:
                 self._trace_ids[req.req_id] = req.trace.trace_id

@@ -94,6 +94,10 @@ def export_request(engine, req: Request):
         "dtype": str(k.dtype),
         "k_len": len(k_bytes),
         "v_len": len(v_bytes),
+        # Trace context rides the manifest so the decode-side import
+        # joins the exporting request's trace instead of opening a
+        # fresh orphan (sampling decided once at ingress).
+        "trace": req.trace.context(),
     }
     _m_exports.labels(outcome="ok").inc()
     _m_bytes.inc(len(k_bytes) + len(v_bytes))
@@ -215,10 +219,14 @@ def import_request(engine, manifest: dict, k_bytes: bytes,
         context_len=ctx,
         cached_tokens=cached,
         t_submit=now, t_admitted=now, t_enqueued=now)
+    # Adopt the trace context the exporter stamped into the manifest:
+    # same trace_id across the handoff, parented under the prefill-side
+    # span, and its sampling decision honored.  Old manifests without
+    # the field fall back to a fresh local trace.
     req.trace = _trace.TRACER.start_trace(
         "serving.migrated", lane=f"req{req_id}",
-        timeline=engine.timeline, req_id=req_id,
-        migrated=True, context_len=ctx, cached_blocks=ncb)
+        timeline=engine.timeline, parent=manifest.get("trace"),
+        req_id=req_id, migrated=True, context_len=ctx, cached_blocks=ncb)
     req.open_phase("decode", migrated=True)
     engine.scheduler.running.append(req)
     engine._assign_slot(req)

@@ -83,8 +83,13 @@ class DisaggRouterConfig:
     ttft_weight: float = 10.0
     burn_weight: float = 5.0
     #: decode-pool scoring: occupancy + outstanding
-    #: + itl_weight * itl_p99
+    #: + itl_weight * itl_p99 - prefix_weight * cached_fraction
     itl_weight: float = 10.0
+    #: bonus for the decode replica whose radix cache already holds the
+    #: migrated prompt's prefix (the import attaches those blocks shared
+    #: — no payload write, no pool pressure).  Scaled by the fraction of
+    #: the prompt cached; kept small so occupancy/ITL still dominate.
+    prefix_weight: float = 0.5
     #: drain() poll cadence
     poll_interval_s: float = 0.02
     #: continuous-dead window before an existing flight fails over
@@ -156,7 +161,8 @@ class LocalDisaggReplica:
         }
 
     def submit_prefill(self, prompt, max_tokens: int, *,
-                       eos_token: Optional[int] = None, mig_id: str):
+                       eos_token: Optional[int] = None, mig_id: str,
+                       trace_ctx: Optional[dict] = None):
         tokens: list[int] = []
 
         def publish(manifest, k_bytes, v_bytes):
@@ -166,8 +172,15 @@ class LocalDisaggReplica:
         fut = self.session.submit(
             prompt, max_tokens, eos_token=eos_token,
             stream_cb=lambda rid, t: tokens.append(int(t)),
-            migrate_cb=publish)
+            migrate_cb=publish, trace_ctx=trace_ctx)
         return (fut, tokens, mig_id)
+
+    def cached_prefix(self, tokens) -> int:
+        """Non-mutating probe: how many leading tokens this replica's
+        radix cache already holds (feeds the router's decode-placement
+        prefix bonus; see :meth:`PrefixCache.peek`)."""
+        pc = self.session.engine.prefix_cache
+        return 0 if pc is None else int(pc.peek(tokens))
 
     def submit_import(self, mig_id: str, *,
                       fetch_timeout_ms: int = 15000):
@@ -368,9 +381,12 @@ class DisaggRouter:
         fl.mig_id = f"{fl.fid}.{fl.prefill_attempts}"
         fl.replica = chosen
         try:
+            # The ingress span's context rides the submit: the prefill
+            # engine joins this flight's trace, and the migration
+            # manifest then carries the same context on to decode.
             fl.handle = chosen.submit_prefill(
                 fl.prompt, fl.max_tokens, eos_token=fl.eos_token,
-                mig_id=fl.mig_id)
+                mig_id=fl.mig_id, trace_ctx=fl.trace.context())
         except Exception as e:
             log.warning("disagg: prefill submit to %s failed: %s",
                         chosen.replica_id, e)
@@ -382,6 +398,21 @@ class DisaggRouter:
         fl.spans["prefill"] = fl.trace.child(
             "PREFILL", after=fl.spans.get("_prev"),
             replica=chosen.replica_id, attempt=fl.attempts)
+
+    def _cached_fraction(self, rep, prompt) -> float:
+        """Fraction of ``prompt`` already resident in ``rep``'s radix
+        cache, through the handle's optional non-mutating
+        ``cached_prefix`` probe.  Handles without one (e.g. the
+        cross-process KV client — a synchronous remote probe per scoring
+        pass would cost more than it saves) contribute 0.0."""
+        probe = getattr(rep, "cached_prefix", None)
+        n = 0 if prompt is None else len(prompt)
+        if probe is None or n == 0:
+            return 0.0
+        try:
+            return min(1.0, max(0.0, probe(prompt) / float(n)))
+        except Exception:
+            return 0.0
 
     def _try_place_decode(self, fl: _Flight, sigs: dict) -> None:
         chaos.fire("router")
@@ -395,7 +426,9 @@ class DisaggRouter:
             s = sigs[rep.replica_id]
             return (s["occupancy"]
                     + outstanding.get(rep.replica_id, 0)
-                    + self.cfg.itl_weight * (s["itl_p99"] or 0.0))
+                    + self.cfg.itl_weight * (s["itl_p99"] or 0.0)
+                    - self.cfg.prefix_weight * self._cached_fraction(
+                        rep, fl.prompt))
 
         chosen = min(eligible, key=score)
         fl.attempts += 1

@@ -238,7 +238,7 @@ class ServingEngine:
 
     # -- public surface --------------------------------------------------
     def submit(self, prompt, max_new_tokens: int, *, eos_token=None,
-               stream_cb=None, migrate_cb=None) -> Request:
+               stream_cb=None, migrate_cb=None, trace_ctx=None) -> Request:
         # Chaos site: admission.  err rejects the request before it
         # queues (the caller sees the raise, nothing leaks into the
         # scheduler); delay throttles intake.
@@ -264,9 +264,12 @@ class ServingEngine:
         # Admission is the root of the request's causal chain: one trace
         # id covers every phase span from here to the terminal state
         # (obs/trace decides sampling; unsampled requests ride NULL_SPAN).
+        # trace_ctx joins a trace started upstream (the frontdoor router's
+        # ingress span, carried through the request transport) instead of
+        # opening a fresh one.
         req.trace = _trace.TRACER.start_trace(
             "serving.request", lane=f"req{req.req_id}",
-            timeline=self.timeline, req_id=req.req_id,
+            timeline=self.timeline, parent=trace_ctx, req_id=req.req_id,
             prompt_len=int(prompt.size), max_new_tokens=max_new_tokens)
         self.scheduler.submit(req)
         return req

@@ -112,6 +112,23 @@ class PrefixCache:
             _m_misses.inc()
         return len(blocks) * self.block_size, blocks
 
+    def peek(self, tokens) -> int:
+        """Matched-token count of the longest cached prefix, without any
+        side effect: no LRU refresh, no hit/miss counters.  The disagg
+        router uses this as a placement probe — a probe that mutated LRU
+        order would let scoring traffic evict real working sets."""
+        n = int(np.asarray(tokens).shape[0])
+        limit_blocks = max(0, n - 1) // self.block_size
+        matched = 0
+        children = self._root
+        for key in self._chunks(tokens, limit_blocks):
+            node = children.get(key)
+            if node is None:
+                break
+            matched += 1
+            children = node.children
+        return matched * self.block_size
+
     def insert(self, tokens, table: Sequence[int]) -> int:
         """Insert the full blocks of a just-prefilled prompt; returns the
         number of NEW nodes.  ``table`` is the request's block table (its

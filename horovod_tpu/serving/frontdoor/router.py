@@ -140,11 +140,13 @@ class LocalReplica:
         }
 
     def submit(self, prompt, max_tokens: int, *,
-               eos_token: Optional[int] = None):
+               eos_token: Optional[int] = None,
+               trace_ctx: Optional[dict] = None):
         tokens: list[int] = []
         fut = self.session.submit(
             prompt, max_tokens, eos_token=eos_token,
-            stream_cb=lambda rid, t: tokens.append(int(t)))
+            stream_cb=lambda rid, t: tokens.append(int(t)),
+            trace_ctx=trace_ctx)
         return (fut, tokens)
 
     def partial_tokens(self, handle) -> list[int]:
@@ -327,8 +329,12 @@ class Router:
         fl.attempts += 1
         fl.replica = chosen
         fl.delivered = 0
+        # The ingress span's context rides the submit so the replica's
+        # engine trace joins this flight's trace_id (one connected trace
+        # across router and replica processes).
         fl.handle = chosen.submit(fl.prompt, fl.max_tokens,
-                                  eos_token=fl.eos_token)
+                                  eos_token=fl.eos_token,
+                                  trace_ctx=fl.trace.context())
         # Queue depth moves immediately so the next placement in this
         # same pass doesn't dogpile the replica that just looked idle.
         sigs[chosen.replica_id]["queue_depth"] += 1

@@ -258,13 +258,17 @@ class ReplicaServer:
                 pass             # progress is best-effort; results are not
 
         mode = payload.get("mode", "generate")
+        # Trace context carried over the transport: the router's ingress
+        # span; the engine joins its trace instead of opening a new one
+        # (decode_import gets it from the migration manifest instead).
+        trace_ctx = payload.get("trace")
         extra = {}
         try:
             if mode == "generate":
                 fut = self.session.submit(
                     payload["prompt"], payload["max_tokens"],
                     eos_token=payload.get("eos_token"),
-                    stream_cb=on_token)
+                    stream_cb=on_token, trace_ctx=trace_ctx)
             elif mode == "prefill_export":
                 # Prefill-pool leg of a disaggregated request: run the
                 # prefill, export the KV blocks, publish them under the
@@ -282,7 +286,8 @@ class ReplicaServer:
                 fut = self.session.submit(
                     payload["prompt"], payload["max_tokens"],
                     eos_token=payload.get("eos_token"),
-                    stream_cb=on_token, migrate_cb=publish)
+                    stream_cb=on_token, migrate_cb=publish,
+                    trace_ctx=trace_ctx)
             elif mode == "decode_import":
                 # Decode-pool leg: fetch the migrated blocks, attach
                 # them to the local pool, resume decoding.  The
@@ -397,15 +402,19 @@ class KVReplicaClient:
         return signals_from_snapshot(snap)
 
     def submit(self, prompt, max_tokens: int, *,
-               eos_token: Optional[int] = None) -> int:
+               eos_token: Optional[int] = None,
+               trace_ctx: Optional[dict] = None) -> int:
         payload = {"prompt": [int(t) for t in np.asarray(prompt)],
                    "max_tokens": int(max_tokens),
                    "eos_token": eos_token}
+        if trace_ctx is not None:
+            payload["trace"] = trace_ctx
         return self._submit_payload(payload)
 
     def submit_prefill(self, prompt, max_tokens: int, *,
                        eos_token: Optional[int] = None,
-                       mig_id: str) -> int:
+                       mig_id: str,
+                       trace_ctx: Optional[dict] = None) -> int:
         """Disaggregated prefill leg: the replica prefills, publishes
         the KV export under ``mig_id``, and resolves with
         ``finish_reason="migrated"``."""
@@ -413,6 +422,8 @@ class KVReplicaClient:
                    "max_tokens": int(max_tokens),
                    "eos_token": eos_token,
                    "mode": "prefill_export", "mig_id": str(mig_id)}
+        if trace_ctx is not None:
+            payload["trace"] = trace_ctx
         return self._submit_payload(payload)
 
     def submit_import(self, mig_id: str, *,

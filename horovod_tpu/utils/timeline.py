@@ -45,6 +45,23 @@ import time
 from typing import Optional
 
 
+def rank_suffixed(path: str, rank: int, np_size: int) -> str:
+    """Per-rank timeline path: ``/path.json`` → ``/path.r3.json`` when
+    the job has more than one process, unchanged for np=1.
+
+    ``HOROVOD_TIMELINE`` names ONE file; co-hosted multi-process workers
+    handed the bare path verbatim would all open it for write and
+    clobber each other's traces.  The ``.r<rank>`` infix keeps the
+    extension (so Perfetto/chrome://tracing still recognize the file)
+    and matches the ``rank[_-]?(\\d+)`` filename convention
+    :func:`merge_timelines`' rank inference already understands.
+    """
+    if np_size <= 1:
+        return path
+    stem, ext = os.path.splitext(path)
+    return f"{stem}.r{int(rank)}{ext}" if ext else f"{path}.r{int(rank)}"
+
+
 class Timeline:
     """Thread-safe Chrome-trace writer; no-op when ``path`` is None.
 
@@ -285,7 +302,8 @@ def load_trace_events(path: str) -> list:
 
 def _infer_rank(path: str, events: list, fallback: int) -> int:
     """A file's rank: the clock_sync stamp when present, else a
-    ``rank<N>`` hint in the filename, else the positional index."""
+    ``rank<N>`` / ``.r<N>.`` hint in the filename (the latter is what
+    :func:`rank_suffixed` emits), else the positional index."""
     for ev in events:
         if ev.get("name") == "clock_sync" and ev.get("ph") == "M":
             r = ev.get("args", {}).get("rank")
@@ -295,7 +313,7 @@ def _infer_rank(path: str, events: list, fallback: int) -> int:
     global _RANK_RE
     if _RANK_RE is None:
         import re
-        _RANK_RE = re.compile(r"rank[_-]?(\d+)")
+        _RANK_RE = re.compile(r"(?:rank[_-]?|\.r)(\d+)")
     m = _RANK_RE.search(os.path.basename(path))
     return int(m.group(1)) if m else fallback
 

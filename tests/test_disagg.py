@@ -388,6 +388,31 @@ def test_import_rejects_geometry_and_torn_payloads(tiny):
     sess_b.engine.pager.check_invariants()
 
 
+def test_migration_manifest_carries_one_connected_trace(tiny):
+    """Regression: the decode-side import must ADOPT the manifest's
+    trace context — same trace_id across export and import, the
+    imported root parented under the exporting request's span — instead
+    of opening a fresh orphan trace."""
+    cfg, params = tiny
+    rng = np.random.RandomState(27)
+    prompt = rng.randint(0, cfg.vocab_size, size=(8,)).astype(np.int32)
+    sess_a, sess_b = _sess(tiny), _sess(tiny)
+    manifest, k_bytes, v_bytes, _ = _export_one(sess_a, prompt, 8)
+    assert manifest.get("trace", {}).get("sampled") is True, manifest
+    tid = manifest["trace"]["trace_id"]
+    fut = sess_b.import_migrated(manifest, k_bytes, v_bytes)
+    sess_b.drain()
+    fut.result(timeout=5)
+    from horovod_tpu.obs import trace as obs_trace
+    exp = obs_trace.TRACER.export(tid)
+    assert exp is not None, "the adopted trace must finish under the " \
+        "exporter's trace_id"
+    root = next(s for s in exp["spans"]
+                if s["name"] == "serving.migrated")
+    assert root["parent_id"] == manifest["trace"]["span_id"], \
+        "import root must be parented under the prefill-side span"
+
+
 def test_import_out_of_slots_raises_out_of_blocks(tiny):
     cfg, params = tiny
     rng = np.random.RandomState(26)
@@ -518,6 +543,36 @@ def test_router_decode_death_reimports_token_identically(tiny):
     assert router.failovers >= 1
     assert streamed == want, \
         f"replay must not re-deliver past the high-water mark: {streamed}"
+
+
+def test_router_decode_placement_prefers_warm_prefix_cache(tiny):
+    """All else equal, decode placement must pick the replica whose
+    radix cache already holds the migrated prompt's prefix (the import
+    attaches those blocks shared), via the side-effect-free peek()."""
+    cfg, params = tiny
+    rng = np.random.RandomState(36)
+    prompt = rng.randint(0, cfg.vocab_size, size=(9,)).astype(np.int32)
+    router, reps, _ = _fleet(tiny, ["prefill", "decode", "decode"])
+    # Warm ONLY the SECOND decode replica (r2) — min() would otherwise
+    # settle the tie on r1, so the prefix bonus must flip the choice.
+    reps[2].session.submit(prompt, 2)
+    reps[2].session.drain()
+    hits = _counter_value("hvd_prefix_cache_hits_total")
+    misses = _counter_value("hvd_prefix_cache_misses_total")
+    assert reps[2].cached_prefix(prompt) >= 4
+    assert reps[1].cached_prefix(prompt) == 0
+    assert _counter_value("hvd_prefix_cache_hits_total") == hits and \
+        _counter_value("hvd_prefix_cache_misses_total") == misses, \
+        "the placement probe must not mutate cache counters/LRU"
+    before = _counter_value("hvd_disagg_placed_total",
+                            pool="decode", replica="r2")
+    fut = router.submit(prompt, 8)
+    router.drain(timeout_s=120)
+    res = fut.result(timeout=5)
+    assert list(res.tokens) == _oracle(params, cfg, prompt, 8)
+    assert _counter_value("hvd_disagg_placed_total", pool="decode",
+                          replica="r2") == before + 1, \
+        "decode must land on the replica holding the cached prefix"
 
 
 def test_router_mixed_pool_serves_both_stages(tiny):

@@ -431,7 +431,8 @@ def test_router_skips_stale_replica():
         def signals(self):
             return dict(self._sig)
 
-        def submit(self, prompt, max_tokens, *, eos_token=None):
+        def submit(self, prompt, max_tokens, *, eos_token=None,
+                   trace_ctx=None):
             self.submitted.append(list(prompt))
             return len(self.submitted) - 1
 

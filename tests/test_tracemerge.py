@@ -1,0 +1,445 @@
+"""obs/tracemerge: cross-process trace propagation, clock-aligned
+fleet merge, critical-path attribution.
+
+Host-only tests (no jax): the trace plane is stdlib-only by design.
+The load-bearing assertions are the acceptance criteria's — parent
+adoption keeps one trace_id across processes with the ingress sampling
+decision final; a merge over missing ranks / crash-cut timeline tails /
+skewed clocks still yields one loadable, per-lane-monotonic Perfetto
+JSON with cross-process flow arrows; and the critical-path report names
+the dominant (phase, rank) the autoscaler consumes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from horovod_tpu.obs import REGISTRY
+from horovod_tpu.obs import server as obs_server
+from horovod_tpu.obs import tracemerge as tm
+from horovod_tpu.obs.trace import NULL_SPAN, Tracer
+from horovod_tpu.utils.timeline import rank_suffixed
+
+
+class _KV:
+    """In-process KV fake with the client surface the trace plane uses
+    (set/get/wait/delete/close)."""
+
+    def __init__(self) -> None:
+        self._data: dict = {}
+        self._cond = threading.Condition()
+
+    def set(self, key, value):
+        with self._cond:
+            self._data[key] = bytes(value)
+            self._cond.notify_all()
+
+    def get(self, key):
+        with self._cond:
+            return self._data.get(key)
+
+    def wait(self, key, timeout_ms=10000):
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._cond:
+            while key not in self._data:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(f"no {key!r}")
+                self._cond.wait(left)
+            return self._data[key]
+
+    def delete(self, key):
+        with self._cond:
+            self._data.pop(key, None)
+
+    def close(self):
+        pass
+
+
+def _finish_trace(tracer, name="req", *, lane=None, parent=None,
+                  children=("PREFILL",)):
+    """One finished trace on ``tracer``; returns its root span."""
+    root = tracer.start_trace(name, lane=lane, parent=parent)
+    for ch in children:
+        sp = root.child(ch)
+        sp.end()
+    root.end()
+    return root
+
+
+# ---------------------------------------------------------------------------
+# propagation: Span.context() / start_trace(parent=...)
+# ---------------------------------------------------------------------------
+
+def test_span_context_carries_the_triple():
+    t = Tracer(sample_rate=1.0)
+    sp = t.start_trace("req")
+    ctx = sp.context()
+    assert ctx["trace_id"] == sp.trace_id
+    assert ctx["span_id"] == sp.span_id
+    assert ctx["sampled"] is True
+    json.dumps(ctx)                       # must ride any transport
+    sp.end()
+
+
+def test_parent_adoption_joins_the_remote_trace():
+    """The far side of a transport adopts (trace_id, span_id): same
+    trace_id, local root parented under the remote span — and the
+    adopted root still FINISHES its trace despite a non-None parent."""
+    a, b = Tracer(sample_rate=1.0), Tracer(sample_rate=1.0)
+    remote = a.start_trace("ingress")
+    ctx = json.loads(json.dumps(remote.context()))   # wire roundtrip
+    local = b.start_trace("serving.migrated", parent=ctx)
+    assert local.trace_id == remote.trace_id
+    assert local.parent_id == remote.span_id
+    local.end()
+    remote.end()
+    exp = b.export(remote.trace_id)
+    assert exp is not None, "adopted root must finish its trace"
+    assert exp["spans"][0]["parent_id"] == remote.span_id
+
+
+def test_parent_accepts_a_live_span_object():
+    t = Tracer(sample_rate=1.0)
+    root = t.start_trace("req")
+    child_root = t.start_trace("hop", parent=root)
+    assert child_root.trace_id == root.trace_id
+    child_root.end()
+    root.end()
+
+
+def test_unsampled_context_is_final_no_local_reroll():
+    """sampled=False at ingress governs the whole chain: a tracer that
+    would sample 100% locally must still return the shared no-op span
+    (same object — zero per-request allocation on the unsampled path)."""
+    t = Tracer(sample_rate=1.0)
+    assert t.start_trace("hop", parent={"sampled": False}) is NULL_SPAN
+    assert t.start_trace("hop", parent=NULL_SPAN) is NULL_SPAN
+    # NULL_SPAN's own context round-trips the decision.
+    assert NULL_SPAN.context() == {"sampled": False}
+
+
+def test_malformed_parent_degrades_to_local_decision():
+    t = Tracer(sample_rate=1.0)
+    sp = t.start_trace("req", parent="garbage-from-an-old-manifest")
+    assert sp is not NULL_SPAN and sp.parent_id is None
+    sp.end()
+
+
+def test_span_ids_are_salted_per_process():
+    """Two tracers' counters both start at 1; the per-process salt keeps
+    (trace_id, span_id) unique fleet-wide — what flow stitching keys on."""
+    a, b = Tracer(sample_rate=1.0), Tracer(sample_rate=1.0)
+    sa, sb = a.start_trace("x"), b.start_trace("x")
+    assert sa.span_id.startswith(a._salt + "-")
+    assert sb.span_id.startswith(b._salt + "-")
+    sa.end(), sb.end()
+
+
+# ---------------------------------------------------------------------------
+# publication + collection over the KV store
+# ---------------------------------------------------------------------------
+
+def test_local_blob_roundtrip():
+    t = Tracer(sample_rate=1.0)
+    _finish_trace(t, lane="req0")
+    blob = tm.decode_trace_blob(tm.local_trace_blob(3, pool="decode",
+                                                    tracer=t))
+    assert blob["rank"] == 3 and blob["pool"] == "decode"
+    assert len(blob["traces"]) == 1
+    with pytest.raises(ValueError):
+        tm.decode_trace_blob(b"[]")
+
+
+def test_publisher_collector_roundtrip():
+    kv = _KV()
+    remote = Tracer(sample_rate=1.0)
+    r_root = _finish_trace(remote, lane="req-remote")
+    pub = tm.TracePublisher(1, pool="prefill", tracer=remote,
+                            kv_factory=lambda: kv,
+                            echo_poll_s=0.005).start()
+    assert pub.publish_now()
+    local = Tracer(sample_rate=1.0)
+    l_root = _finish_trace(local, lane="req-local")
+    col = tm.TraceCollector(own_rank=0, own_pool="router", tracer=local,
+                            kv_factory=lambda: kv)
+    try:
+        merged = col.collect()
+    finally:
+        col.close()
+        pub.stop()
+    assert merged["ranks"] == [0, 1]
+    tids = {e["args"].get("trace_id") for e in merged["traceEvents"]
+            if e.get("ph") == "X"}
+    assert {r_root.trace_id, l_root.trace_id} <= tids
+    assert merged["report"]["n_traces"] == 2
+    json.dumps(merged)                    # one loadable /tracez payload
+
+
+def test_clock_offset_ping_echo():
+    kv = _KV()
+    pub = tm.TracePublisher(2, tracer=Tracer(sample_rate=1.0),
+                            kv_factory=lambda: kv, interval_s=60,
+                            echo_poll_s=0.005).start()
+    try:
+        off = tm.estimate_clock_offset(kv, 2, timeout_s=2.0)
+    finally:
+        pub.stop()
+    # Same host, same clock: the measured offset is bounded by the echo
+    # round trip, far under a second.
+    assert off is not None and abs(off) < 5e5, off
+    # A rank that never echoes yields None, not a hang/crash.
+    assert tm.estimate_clock_offset(kv, 9, attempts=1,
+                                    timeout_s=0.05) is None
+
+
+# ---------------------------------------------------------------------------
+# merge robustness
+# ---------------------------------------------------------------------------
+
+def _blob(rank, trace_id, spans, *, t_start=100.0, pool=None, tail=()):
+    return {"rank": rank, "pool": pool,
+            "traces": [{"trace_id": trace_id, "name": "req",
+                        "lane": f"req{rank}", "t_start_unix": t_start,
+                        "spans": list(spans)}],
+            "timeline_tail": list(tail)}
+
+
+def _span(sid, name, t0, dur, parent=None):
+    sp = {"span_id": sid, "name": name, "t_offset_s": t0,
+          "duration_s": dur}
+    if parent:
+        sp["parent_id"] = parent
+    return sp
+
+
+def test_merge_missing_rank_is_partial_not_fatal():
+    blobs = {0: _blob(0, "t1", [_span("a-1", "req", 0.0, 1.0)]),
+             2: _blob(2, "t2", [_span("c-1", "req", 0.0, 1.0)])}
+    merged = tm.merge_fleet_trace(blobs)
+    assert merged["ranks"] == [0, 2]
+    assert {e["pid"] for e in merged["traceEvents"]
+            if e.get("ph") == "X"} == {0, 2}
+    json.dumps(merged)
+
+
+def test_merge_skewed_clocks_stays_per_lane_monotonic():
+    """300s of wall-clock skew on rank 1, corrected by its measured
+    offset: every lane's events still come out time-sorted and
+    non-negative on the collector's axis."""
+    skew_us = 300e6
+    blobs = {
+        0: _blob(0, "t1", [_span("a-1", "INGRESS", 0.0, 0.5),
+                           _span("a-2", "QUEUE", 0.5, 0.2, "a-1")]),
+        1: _blob(1, "t1", [_span("b-1", "DECODE", 0.0, 0.4, "a-1"),
+                           _span("b-2", "DECODE", 0.4, 0.4, "a-1")],
+                 t_start=100.1 + skew_us / 1e6),
+    }
+    merged = tm.merge_fleet_trace(blobs, offsets_us={1: skew_us})
+    lanes: dict = {}
+    for ev in merged["traceEvents"]:
+        if ev.get("ph") == "X":
+            assert ev["ts"] >= 0, ev
+            lanes.setdefault((ev["pid"], ev["tid"]), []).append(ev["ts"])
+    assert lanes, "no slices emitted"
+    for ts in lanes.values():
+        assert ts == sorted(ts), "lane must be emitted monotonically"
+    # Rank 1's slices landed near rank 0's axis, not 300s away.
+    r1 = [e for e in merged["traceEvents"]
+          if e.get("ph") == "X" and e["pid"] == 1]
+    assert all(e["ts"] < 10e6 for e in r1), r1
+
+
+def test_merge_truncated_timeline_tail(tmp_path):
+    """A crash-cut timeline file (no closing bracket) still merges: its
+    events rebase through the clock_sync anchor and land in the report's
+    busy table."""
+    path = os.path.join(str(tmp_path), "tl.r1.json")
+    evs = [{"name": "clock_sync", "ph": "M", "pid": 0, "tid": 0,
+            "args": {"rank": 1, "epoch_us": 100.0e6}},
+           {"name": "thread_name", "ph": "M", "pid": 0, "tid": 7,
+            "args": {"name": "allreduce.grad"}},
+           {"name": "MPI_ALLREDUCE", "ph": "X", "pid": 0, "tid": 7,
+            "ts": 1000.0, "dur": 500.0}]
+    with open(path, "w") as fh:                  # crash-cut: no ']'
+        fh.write("[\n" + ",\n".join(json.dumps(e) for e in evs) + ",\n")
+    blob = tm.decode_trace_blob(tm.local_trace_blob(
+        1, tracer=Tracer(sample_rate=1.0), timeline_path=path))
+    assert blob["timeline_tail"], "tail must survive the truncation"
+    merged = tm.merge_fleet_trace({1: blob})
+    rows = [e for e in merged["traceEvents"]
+            if e.get("name") == "MPI_ALLREDUCE"]
+    assert len(rows) == 1 and rows[0]["pid"] == 1
+    report = tm.critical_path_report({1: blob})
+    assert report["timeline_busy"][0]["name"] == "MPI_ALLREDUCE"
+    assert report["timeline_busy"][0]["rank"] == 1
+
+
+def test_merge_tail_without_clock_anchor_is_skipped():
+    tail = [{"name": "X1", "ph": "X", "pid": 0, "tid": 1,
+             "ts": 5.0, "dur": 1.0}]      # no clock_sync: unanchorable
+    merged = tm.merge_fleet_trace(
+        {0: _blob(0, "t1", [_span("a-1", "req", 0.0, 1.0)], tail=tail)})
+    assert not [e for e in merged["traceEvents"] if e.get("name") == "X1"]
+    assert [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+
+
+def test_cross_process_flow_arrows():
+    """A span whose parent lives on another rank gets an s→f handoff
+    arrow; the arrow never points backward in time."""
+    blobs = {
+        0: _blob(0, "t1", [_span("a-1", "INGRESS", 0.0, 0.3)],
+                 pool="router"),
+        1: _blob(1, "t1", [_span("b-1", "serving.migrated",
+                                 0.0, 0.5, "a-1")],
+                 t_start=100.2, pool="decode"),
+    }
+    merged = tm.merge_fleet_trace(blobs)
+    flows = [e for e in merged["traceEvents"] if e.get("cat") == "trace"]
+    starts = [e for e in flows if e["ph"] == "s"]
+    finishes = [e for e in flows if e["ph"] == "f"]
+    assert len(starts) == 1 and len(finishes) == 1
+    s, f = starts[0], finishes[0]
+    assert s["id"] == f["id"]
+    assert s["pid"] == 0 and f["pid"] == 1, "arrow must cross processes"
+    assert f["bp"] == "e"
+    assert s["ts"] <= f["ts"]
+    pools = {e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert pools == {"rank 0 [router]", "rank 1 [decode]"}
+
+
+def test_intra_process_edges_get_no_merge_arrows():
+    blobs = {0: _blob(0, "t1", [_span("a-1", "req", 0.0, 1.0),
+                                _span("a-2", "QUEUE", 0.1, 0.2, "a-1")])}
+    merged = tm.merge_fleet_trace(blobs)
+    assert not [e for e in merged["traceEvents"]
+                if e.get("cat") == "trace"]
+
+
+# ---------------------------------------------------------------------------
+# critical-path attribution
+# ---------------------------------------------------------------------------
+
+def _two_rank_trace():
+    # root on rank 0 covers [0, 1.0]; its rank-1 DECODE child covers
+    # [0.1, 0.9] => self(root)=0.2, self(DECODE)=0.8 (the dominant).
+    return {
+        0: _blob(0, "t1", [_span("a-1", "disagg.request", 0.0, 1.0)]),
+        1: _blob(1, "t1", [_span("b-1", "DECODE", 0.1, 0.8, "a-1")]),
+    }
+
+
+def test_critical_path_names_dominant_phase_and_rank():
+    report = tm.critical_path_report(_two_rank_trace())
+    assert report["n_traces"] == 1
+    assert report["dominant_phase"] == "DECODE"
+    assert report["dominant_rank"] == 1
+    worst = report["slowest"][0]
+    assert worst["n_ranks"] == 2
+    assert worst["dominant_self_s"] == pytest.approx(0.8)
+    by_phase = {(p["phase"], p["rank"]): p["self_s"]
+                for p in worst["phases"]}
+    assert by_phase[("disagg.request", 0)] == pytest.approx(0.2)
+
+
+def test_critical_gauges_export():
+    tm.export_critical_gauges(tm.critical_path_report(_two_rank_trace()))
+    fam = REGISTRY.get("hvd_trace_critical_phase_seconds")
+    assert fam.labels(phase="DECODE", rank="1").value == \
+        pytest.approx(0.8)
+
+
+def test_critical_seconds_feed_autoscale_straggler_signal():
+    """A rank owning the majority of the fleet's critical time counts as
+    a straggler in the autoscaler's signal distillation; a balanced
+    fleet contributes none."""
+    from horovod_tpu.autoscale.controller import signals_from_families
+
+    def fams(split):
+        return [
+            {"name": "horovod_tpu_rank_snapshot_age_seconds",
+             "samples": [{"labels": {"rank": "0"}, "value": 0.1},
+                         {"labels": {"rank": "1"}, "value": 0.1}]},
+            {"name": "hvd_trace_critical_phase_seconds",
+             "samples": [{"labels": {"phase": "DECODE", "rank": "1"},
+                          "value": split},
+                         {"labels": {"phase": "req", "rank": "0"},
+                          "value": 1.0 - split}]},
+        ]
+
+    assert signals_from_families(fams(0.9), current_np=2,
+                                 available_slots=2).stragglers == 1
+    assert signals_from_families(fams(0.5), current_np=2,
+                                 available_slots=2).stragglers == 0
+
+
+# ---------------------------------------------------------------------------
+# /tracez endpoint + CLI fetch
+# ---------------------------------------------------------------------------
+
+def test_tracez_endpoint_and_cli_fetch(tmp_path):
+    t = Tracer(sample_rate=1.0)
+    _finish_trace(t, lane="req0")
+    col = tm.TraceCollector(own_rank=0, tracer=t,
+                            kv_factory=lambda: None)
+    srv = obs_server.MetricsServer(0, addr="127.0.0.1")
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        obs_server.set_trace_provider(col.collect)
+        with urllib.request.urlopen(f"{base}/tracez", timeout=5) as r:
+            merged = json.loads(r.read().decode())
+        assert merged["ranks"] == [0]
+        assert any(e.get("ph") == "X" for e in merged["traceEvents"])
+        assert "report" in merged
+
+        out = os.path.join(str(tmp_path), "fleet.json")
+        assert tm.main(["fetch", base, "-o", out, "--report"]) == 0
+        with open(out) as fh:
+            assert json.load(fh)["ranks"] == [0]
+
+        # A provider that blows up still answers with a loadable body.
+        obs_server.set_trace_provider(
+            lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+        with urllib.request.urlopen(f"{base}/tracez", timeout=5) as r:
+            degraded = json.loads(r.read().decode())
+        assert degraded["traceEvents"] == [] and "boom" in degraded["error"]
+
+        # Unarmed => 503, not a hang.
+        obs_server.set_trace_provider(None)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/tracez", timeout=5)
+        assert ei.value.code == 503
+    finally:
+        obs_server.set_trace_provider(None)
+        srv.close()
+        col.close()
+
+
+def test_fleet_trace_fallback_works_unarmed():
+    merged = tm.fleet_trace()
+    assert "traceEvents" in merged and "report" in merged
+
+
+# ---------------------------------------------------------------------------
+# per-rank timeline paths (satellite: HVDTPU_TIMELINE under np>1)
+# ---------------------------------------------------------------------------
+
+def test_rank_suffixed_paths():
+    assert rank_suffixed("/tmp/tl.json", 3, 4) == "/tmp/tl.r3.json"
+    assert rank_suffixed("/tmp/tl.json", 0, 4) == "/tmp/tl.r0.json"
+    assert rank_suffixed("/tmp/tl.json", 0, 1) == "/tmp/tl.json", \
+        "np=1 must keep the bare path"
+    assert rank_suffixed("/tmp/trace", 2, 4) == "/tmp/trace.r2"
+
+
+def test_rank_suffixed_is_inferrable_by_merge():
+    from horovod_tpu.utils.timeline import _infer_rank
+    assert _infer_rank(rank_suffixed("/tmp/tl.json", 3, 4), [], 0) == 3
