@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: ci native test mp-test examples bench baseline-table image \
 	autoscale-recovery disagg-recovery perf-regress bench-trajectory \
-	hierarchical-parity compiled-parity zero1-parity trace
+	hierarchical-parity compiled-parity zero1-parity trace alertz
 
 # The autoscale-recovery CI job standalone: np=4 MoE job, injected rank
 # death + SLO load spike => shrink to np=2, grow back to np=4.
@@ -30,6 +30,15 @@ TRACE_OUT ?= /tmp/hvdtpu_fleet_trace.json
 trace:
 	$(PY) -m horovod_tpu.obs.tracemerge fetch $(TRACE_URL) \
 		-o $(TRACE_OUT) --report
+
+# Pull a running job's alert-engine state (obs/alerts.py; text render of
+# /alertz — firing/pending rules with values, hold timers, fire counts).
+#   make alertz ALERTZ_URL=http://host:9464
+ALERTZ_URL ?= http://127.0.0.1:9464
+alertz:
+	@curl -fsS $(ALERTZ_URL)/alertz || \
+		$(PY) -c "import urllib.request,sys; \
+sys.stdout.write(urllib.request.urlopen('$(ALERTZ_URL)/alertz', timeout=5).read().decode())"
 
 ci: native
 	$(PY) -c "import horovod_tpu, horovod_tpu.torch, horovod_tpu.tensorflow, \

@@ -666,7 +666,8 @@ def main() -> None:
     # pass is sub-second on this rig and single-pass deltas swing far
     # beyond the 2% being measured (scheduler noise, not obs cost).
     rates: dict[str, list[float]] = {"on": [], "trace": [], "prof": [],
-                                     "off": []}
+                                     "tsdb": [], "off": []}
+    from horovod_tpu.obs import tsdb as obs_tsdb
     try:
         for _ in range(3):
             # metrics + aggregation, tracing off — the registry cost
@@ -689,6 +690,17 @@ def main() -> None:
             tok, wall, _ = run_engine(sess, reqs, 0.0)
             rates["prof"].append(tok / wall)
             obs_prof.PROFILER.stop()
+            # + the time-series sampler: full registry snapshots into
+            # the history rings.  A closed pass is sub-second, so the
+            # default 5s cadence would never tick inside it — sample at
+            # 50ms instead, a 100x-conservative upper bound on the
+            # production cost.
+            obs_tsdb.arm(interval_s=0.05, retention_s=60.0)
+            try:
+                tok, wall, _ = run_engine(sess, reqs, 0.0)
+            finally:
+                obs_tsdb.disarm()
+            rates["tsdb"].append(tok / wall)
             agg_pause.set()
             obs.REGISTRY.disable()
             try:
@@ -703,12 +715,17 @@ def main() -> None:
         obs_trace.TRACER.sample_rate = saved_rate
         if prof_was_running:
             obs_prof.PROFILER.start()
-    rate_on, rate_tr, rate_pr, rate_off = (float(np.median(rates[k]))
-                                           for k in ("on", "trace",
-                                                     "prof", "off"))
+    rate_on, rate_tr, rate_pr, rate_ts, rate_off = (
+        float(np.median(rates[k]))
+        for k in ("on", "trace", "prof", "tsdb", "off"))
     overhead_pct = (rate_off - rate_on) / rate_off * 100.0
     trace_overhead_pct = (rate_off - rate_tr) / rate_off * 100.0
     prof_overhead_pct = (rate_off - rate_pr) / rate_off * 100.0
+    tsdb_stress_pct = (rate_off - rate_ts) / rate_off * 100.0
+    # The 50ms stress cadence is 100x the 5s default; per-tick cost is
+    # the same, so the production overhead is the stress number / 100.
+    # That normalized figure is what the <2% budget governs.
+    tsdb_overhead_pct = tsdb_stress_pct / 100.0
     print(f"[obs overhead] metrics+aggregation on {rate_on:.1f} tok/s vs "
           f"off {rate_off:.1f} tok/s = {overhead_pct:+.2f}% "
           f"({'within' if overhead_pct < 2.0 else 'OVER'} the 2% budget)")
@@ -719,6 +736,11 @@ def main() -> None:
     print(f"[obs overhead] +profiler@10Hz {rate_pr:.1f} tok/s vs "
           f"off {rate_off:.1f} tok/s = {prof_overhead_pct:+.2f}% "
           f"({'within' if prof_overhead_pct < 2.0 else 'OVER'} "
+          f"the 2% budget)")
+    print(f"[obs overhead] +tsdb@50ms {rate_ts:.1f} tok/s vs "
+          f"off {rate_off:.1f} tok/s = {tsdb_stress_pct:+.2f}% at 100x "
+          f"the default 5s cadence -> {tsdb_overhead_pct:+.3f}% at "
+          f"default ({'within' if tsdb_overhead_pct < 2.0 else 'OVER'} "
           f"the 2% budget)")
 
     base_rate = base_tok / base_s
@@ -749,6 +771,8 @@ def main() -> None:
             "metrics_overhead_pct": round(overhead_pct, 3),
             "tracing_overhead_pct": round(trace_overhead_pct, 3),
             "prof_overhead_pct": round(prof_overhead_pct, 3),
+            "tsdb_overhead_pct": round(tsdb_overhead_pct, 4),
+            "tsdb_stress_overhead_pct": round(tsdb_stress_pct, 3),
             "slo": args.slo,
             "d_model": cfg.d_model,
             "n_layers": cfg.n_layers,

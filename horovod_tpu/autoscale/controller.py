@@ -33,6 +33,7 @@ from typing import Callable, Optional
 from .policy import Decision, PolicyConfig, ScalePolicy, Signals
 from ..obs import REGISTRY as _obs
 from ..obs import flightrec as _frec
+from ..obs import tsdb as _tsdb
 from ..utils import logging as hvd_logging
 
 log = hvd_logging.get_logger()
@@ -62,10 +63,58 @@ _m_stale = _obs.counter(
     "ticks skipped because every rank snapshot was frozen")
 
 
+#: queue-depth family names the forecast trends over (the same pair the
+#: instantaneous queue signal reads).
+_QUEUE_FAMILIES = ("hvd_engine_queue_depth", "hvd_serving_queue_depth")
+
+
+def _forecast_from_store(store, *, horizon_s: float, fresh: set,
+                         pool: Optional[str], now: float):
+    """(queue_forecast, burn_forecast) off the controller's history.
+
+    Per matching series, a Theil–Sen trend over a lookback of twice the
+    horizon (floored at 60s — a forecast off two points is noise), then
+    the max across series: the rank forecast to saturate first is the
+    one capacity must land for.  Series from stale ranks don't vote,
+    same as the instantaneous signals.
+    """
+    if store is None or horizon_s <= 0:
+        return None, None
+    lookback = max(60.0, 2.0 * horizon_s)
+
+    def votes(labels) -> bool:
+        r = labels.get("rank")
+        if r is None:
+            return pool is None
+        return str(r) in fresh
+
+    def best(name: str, matchers=None):
+        out = None
+        for labels, ser in store.select(name, matchers):
+            if not votes(labels):
+                continue
+            pts = ser.points(now - lookback, now)
+            v = _tsdb.forecast_points(pts, horizon_s, now=now)
+            if v is not None:
+                out = v if out is None else max(out, v)
+        return out
+
+    queue_fc = None
+    for fam in _QUEUE_FAMILIES:
+        v = best(fam)
+        if v is not None:
+            queue_fc = v if queue_fc is None else max(queue_fc, v)
+    burn_fc = best("hvd_slo_burn_rate", {"window": "5m"})
+    return queue_fc, burn_fc
+
+
 def signals_from_families(families: list, *, current_np: int,
                           available_slots: int,
                           stale_after_s: float = 10.0,
-                          pool: Optional[str] = None) -> Signals:
+                          pool: Optional[str] = None,
+                          store=None,
+                          forecast_horizon_s: float = 0.0,
+                          now: Optional[float] = None) -> Signals:
     """Distill a merged ``/cluster`` snapshot into policy inputs.
 
     Rank-labeled samples from STALE ranks (snapshot age over
@@ -80,6 +129,11 @@ def signals_from_families(families: list, *, current_np: int,
     the decode pool (or vice versa).  Ranks that publish no pool tag
     (training workers, old replicas) are excluded from a pool-filtered
     view rather than voting in every pool.
+
+    With a ``store`` (the controller's tsdb history of these snapshots)
+    and ``forecast_horizon_s > 0``, ``queue_forecast``/``burn_forecast``
+    carry the robust linear-trend prediction that many seconds ahead —
+    the predictive-grow input (``ScalePolicy`` rule 5).
     """
     ages: dict[str, float] = {}
     pools: dict[str, str] = {}
@@ -152,10 +206,14 @@ def signals_from_families(families: list, *, current_np: int,
         for r, v in crit_by_rank.items():
             if v > 0.5 * total_crit:
                 stragglers.add(r)
+    queue_fc, burn_fc = _forecast_from_store(
+        store, horizon_s=forecast_horizon_s, fresh=fresh, pool=pool,
+        now=time.monotonic() if now is None else now)
     return Signals(current_np=current_np, available_slots=available_slots,
                    queue_depth=queue, stragglers=len(stragglers),
                    burn_fast=burn_fast, burn_slow=burn_slow,
-                   signal_age_s=age)
+                   signal_age_s=age, queue_forecast=queue_fc,
+                   burn_forecast=burn_fc)
 
 
 class AutoscaleController:
@@ -178,6 +236,7 @@ class AutoscaleController:
                  prev_np: Optional[int] = None,
                  interval_s: float = 2.0,
                  pool: Optional[str] = None,
+                 store: Optional[_tsdb.SeriesStore] = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         self._policy = policy
         self._np = int(current_np)
@@ -190,6 +249,12 @@ class AutoscaleController:
         self._pool = pool
         self._m_target = _m_target.labels(pool=pool or "all")
         self._clock = clock
+        # The controller keeps its own bounded history of every /cluster
+        # snapshot it collects (timestamps on ITS clock, so ingest and
+        # forecast eval agree) — predictive scaling works on the driver
+        # even when the process-wide tsdb tier isn't armed there.
+        self._store = store if store is not None else _tsdb.SeriesStore(
+            interval_s=max(0.05, interval_s), name="autoscale")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_recorded: Optional[tuple] = None
@@ -226,10 +291,17 @@ class AutoscaleController:
         except Exception as e:
             log.warning("autoscale: aggregator collect failed: %s", e)
             families = []
+        now = self._clock()
+        try:
+            self._store.ingest(families, now)
+        except Exception as e:
+            log.warning("autoscale: tsdb ingest failed: %s", e)
         sig = signals_from_families(
             families, current_np=self._np, available_slots=cap,
             stale_after_s=self._policy.config.stale_after_s,
-            pool=self._pool)
+            pool=self._pool, store=self._store,
+            forecast_horizon_s=self._policy.config.forecast_horizon_s,
+            now=now)
         decision = self._policy.decide(sig)
         if sig.signal_age_s == float("inf"):
             _m_stale.inc()
@@ -257,7 +329,8 @@ class AutoscaleController:
         # decision that actually changes np gets acted on.  If a bump is
         # absorbed (worker not yet baselined), the gap persists, the
         # cooldown lapses, and the policy re-decides — retry for free.
-        if ((d.action == "grow" and d.target_np > self._np)
+        if ((d.action in ("grow", "grow_predicted")
+             and d.target_np > self._np)
                 or (d.action == "shrink" and d.target_np < self._np)):
             self._set_target(d.target_np)
             self._bump_safe(d)

@@ -23,7 +23,13 @@ Decision rules (in order):
    straggler in flight (a stall makes the idle reading unreliable).
    Gated by the (longer) scale-down cooldown; shrinks by
    ``shrink_divisor`` per decision, never below ``min_np``.
-5. **Between ``queue_low`` and ``queue_high`` nothing happens** — the
+5. **Predictive scale-up** (``forecast_horizon_s > 0``): when the robust
+   linear trend over the queue-depth history says ``queue_high`` will be
+   crossed within the lookahead, grow *before* the instantaneous
+   threshold trips (``action="grow_predicted"``) — capacity lands ahead
+   of the load.  Shares the scale-up cooldown; a ramping queue also
+   vetoes the idle shrink.
+6. **Between ``queue_low`` and ``queue_high`` nothing happens** — the
    hysteresis band that keeps a borderline load from flapping the mesh.
 
 Both cooldowns also gate the FIRST decision: policy construction stamps
@@ -57,6 +63,11 @@ class PolicyConfig:
     stale_after_s: float = 10.0
     #: voluntary shrink halves by default (np -> np // 2).
     shrink_divisor: int = 2
+    #: predictive scaling lookahead: grow when the forecast queue depth
+    #: this many seconds ahead crosses ``queue_high`` even though the
+    #: instantaneous reading hasn't.  0 = reactive only.  Shares the
+    #: scale-up cooldown; hysteresis unchanged.
+    forecast_horizon_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -76,12 +87,17 @@ class Signals:
     burn_slow: float = 0.0
     #: age of the FRESHEST rank snapshot; inf when nobody reports.
     signal_age_s: float = 0.0
+    #: robust linear-trend forecast of queue depth ``forecast_horizon_s``
+    #: ahead (None = no history / forecasting off).
+    queue_forecast: "float | None" = None
+    #: same forecast for the fast-window SLO burn rate.
+    burn_forecast: "float | None" = None
 
 
 @dataclasses.dataclass(frozen=True)
 class Decision:
     target_np: int
-    action: str            # "grow" | "shrink" | "hold"
+    action: str    # "grow" | "grow_predicted" | "shrink" | "hold"
     reason: str
 
 
@@ -131,6 +147,36 @@ class ScalePolicy:
                 return Decision(target, "grow", why)
             return Decision(s.current_np, "hold",
                             "pressure but at capacity "
+                            f"(np={s.current_np}, cap={cap})")
+
+        # Predictive scale-up: the robust trend over the queue-depth
+        # series says the high threshold will be crossed within the
+        # lookahead — grow now so the capacity lands before the load
+        # does, not after.  Same cooldown stamp as a reactive grow (one
+        # scale-up per cooldown, whoever triggers it); a ramping queue
+        # also vetoes the idle shrink below by construction (this branch
+        # returns first).
+        predicted = (cfg.forecast_horizon_s > 0
+                     and s.queue_forecast is not None
+                     and s.queue_forecast >= cfg.queue_high)
+        if predicted:
+            target = cap
+            if target > s.current_np:
+                if now - self._last_up < cfg.scale_up_cooldown_s:
+                    return Decision(
+                        s.current_np, "hold",
+                        "scale-up cooldown (predicted breach waiting "
+                        f"{now - self._last_up:.1f}s of "
+                        f"{cfg.scale_up_cooldown_s:.0f}s)")
+                self._last_up = now
+                return Decision(
+                    target, "grow_predicted",
+                    f"queue forecast {s.queue_forecast:.1f} >= "
+                    f"{cfg.queue_high:.1f} within "
+                    f"{cfg.forecast_horizon_s:.0f}s "
+                    f"(now {s.queue_depth:.1f})")
+            return Decision(s.current_np, "hold",
+                            "predicted pressure but at capacity "
                             f"(np={s.current_np}, cap={cap})")
 
         if idle:

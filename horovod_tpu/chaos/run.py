@@ -275,6 +275,58 @@ def scenario_elastic(np_total: int = 4, verbose: bool = False) -> None:
 # scenario: autoscale closed loop (shrink on preemption, grow back)
 # ---------------------------------------------------------------------------
 
+def _predictive_grow_leg() -> None:
+    """Forecast-fed scale-up, fully deterministic (fake clock, fake
+    collect): a queue ramp of +0.5/s at np=2 with ``queue_high=8`` and a
+    30s lookahead must fire ``action="grow_predicted"`` while the
+    instantaneous depth is still below 8."""
+    from ..autoscale import PolicyConfig, ScalePolicy
+    from ..autoscale.controller import AutoscaleController
+    from ..obs import tsdb
+
+    clk = [1000.0]
+    depth = [0.0]
+
+    def collect():
+        return [
+            {"name": "horovod_tpu_rank_snapshot_age_seconds",
+             "type": "gauge", "help": "", "labelnames": ("rank", "stale"),
+             "samples": [{"labels": {"rank": "0", "stale": "false"},
+                          "value": 0.0}]},
+            {"name": "hvd_serving_queue_depth", "type": "gauge",
+             "help": "", "labelnames": (),
+             "samples": [{"labels": {"rank": "0"}, "value": depth[0]}]},
+        ]
+
+    policy = ScalePolicy(
+        PolicyConfig(min_np=2, max_np=4, queue_high=8.0,
+                     forecast_horizon_s=30.0, scale_up_cooldown_s=0.0),
+        clock=lambda: clk[0])
+    bumps = []
+    ctl = AutoscaleController(
+        policy, current_np=2, collect=collect,
+        bump=lambda: bumps.append(1), capacity=lambda: 4,
+        store=tsdb.SeriesStore(interval_s=1.0, name="chaos-predict"),
+        clock=lambda: clk[0])
+    depth_at_decision = None
+    for _ in range(20):
+        d = ctl.poll_once()
+        if d.action == "grow_predicted":
+            depth_at_decision = depth[0]
+            break
+        clk[0] += 1.0
+        depth[0] += 0.5
+    assert depth_at_decision is not None, \
+        [x.action for x in ctl.decisions]
+    assert depth_at_decision < 8.0, \
+        f"predictive grow fired only at depth {depth_at_decision}"
+    assert bumps, "grow_predicted decision never bumped the epoch"
+    d = next(x for x in ctl.decisions if x.action == "grow_predicted")
+    assert d.target_np == 4 and "forecast" in d.reason, d
+    print(f"CHAOS-AUTOSCALE predictive leg OK: grow_predicted at "
+          f"depth={depth_at_decision:.1f} (<8.0) [{d.reason}]")
+
+
 def scenario_autoscale(verbose: bool = False) -> None:
     """np=4 expert-parallel MoE job under the closed-loop autoscaler:
     an injected rank death blacklists its host (shrink to np=2, recorded
@@ -285,11 +337,19 @@ def scenario_autoscale(verbose: bool = False) -> None:
     Asserts exact state continuity across both resizes (monotone
     resume_step, allreduce-of-ones == world size every step) and that
     every decision surfaced as ``hvd_autoscale_*`` metrics +
-    flight-recorder events in the driver process."""
+    flight-recorder events in the driver process.
+
+    A deterministic predictive leg runs first: an injected queue-depth
+    ramp through the real controller + tsdb history must produce a
+    ``grow_predicted`` decision from ``Signals.queue_forecast`` while
+    the instantaneous queue is still *below* ``queue_high`` — capacity
+    moves before the threshold trips, not after."""
     from ..autoscale import PolicyConfig
     from ..obs import REGISTRY
     from ..obs import flightrec
     from ..runner.elastic import ElasticDriver, FixedDiscovery
+
+    _predictive_grow_leg()
 
     work = tempfile.mkdtemp(prefix="hvdtpu_chaos_as_")
     state_path = os.path.join(work, "state.json")
@@ -366,6 +426,8 @@ def scenario_autoscale(verbose: bool = False) -> None:
                  for s in snap["hvd_autoscale_decisions_total"]["samples"]}
     assert decisions.get("shrink", 0) >= 1, decisions
     assert decisions.get("grow", 0) >= 1, decisions
+    # The predictive leg's decision rode the same counter + event path.
+    assert decisions.get("grow_predicted", 0) >= 1, decisions
     assert snap["hvd_autoscale_target_np"]["samples"][0]["value"] == 4.0, \
         snap["hvd_autoscale_target_np"]["samples"]
     assert snap["hvd_autoscale_rendezvous_bumps_total"]["samples"][0][
@@ -373,7 +435,7 @@ def scenario_autoscale(verbose: bool = False) -> None:
     frec_events = [e for e in flightrec.RECORDER.snapshot()
                    if e.get("kind") == "autoscale_decision"]
     actions = {e.get("name") for e in frec_events}
-    assert {"shrink", "grow"} <= actions, actions
+    assert {"shrink", "grow", "grow_predicted"} <= actions, actions
     print(f"CHAOS-AUTOSCALE-OK 4->2->4 decisions={decisions} "
           f"wall={dt:.0f}s")
 

@@ -168,6 +168,22 @@ class Config:
     # Seconds between SLO histogram snapshots / evaluations.
     slo_tick_s: float = 10.0
 
+    # --- time-series tier (obs/tsdb.py) ---
+    # Seconds between registry samples into the in-memory history rings
+    # (raw ring at this cadence, 60s-downsampled ring behind it);
+    # <= 0 disables the tier (and /query answers 503-equivalent errors).
+    tsdb_interval_s: float = 5.0
+    # Raw-ring retention in seconds; the downsampled ring keeps a fixed
+    # ~2h at 60s resolution regardless.
+    tsdb_retention_s: float = 600.0
+
+    # --- declarative alerting (obs/alerts.py) ---
+    # Semicolon-separated alert rules over the time-series tier, e.g.
+    # "queue: avg_over_time(hvd_serving_queue_depth[1m]) > 8 for 30s : warn".
+    # None = no alert engine; armed at init(), firing gauges ride
+    # /metrics and /cluster, state at /alertz.
+    alerts: Optional[str] = None
+
     # --- sampling profiler (obs/prof.py) ---
     # Stack-sampling rate in Hz for the always-on profiler; 0 disables.
     # 10 Hz costs ~100 us/tick for a dozen threads — well inside the <2%
@@ -263,6 +279,11 @@ class Config:
     autoscale_down_cooldown_s: float = 120.0
     # Freshest rank snapshot older than this => signals frozen, hold.
     autoscale_stale_s: float = 10.0
+    # Predictive scaling: grow when the robust linear-trend forecast of
+    # queue depth this many seconds ahead crosses queue_high, even
+    # before the instantaneous threshold trips.  0 disables (reactive
+    # only); cooldowns and hysteresis apply unchanged.
+    autoscale_forecast_horizon_s: float = 0.0
 
     # --- coordination / rendezvous († gloo_context.cc reads of env) ---
     coordinator_addr: Optional[str] = None  # host:port of JAX coordination svc
@@ -309,6 +330,9 @@ _ENV_TABLE = [
     ("trace_sample", "TRACE_SAMPLE", float),
     ("slo", "SLO", str),
     ("slo_tick_s", "SLO_TICK_SECONDS", float),
+    ("tsdb_interval_s", "TSDB_INTERVAL", float),
+    ("tsdb_retention_s", "TSDB_RETENTION", float),
+    ("alerts", "ALERTS", str),
     ("prof_hz", "PROF_HZ", float),
     ("prof_max_stacks", "PROF_MAX_STACKS", int),
     ("prof_ring", "PROF_RING", int),
@@ -339,6 +363,7 @@ _ENV_TABLE = [
     ("autoscale_up_cooldown_s", "AUTOSCALE_UP_COOLDOWN_SECONDS", float),
     ("autoscale_down_cooldown_s", "AUTOSCALE_DOWN_COOLDOWN_SECONDS", float),
     ("autoscale_stale_s", "AUTOSCALE_STALE_SECONDS", float),
+    ("autoscale_forecast_horizon_s", "AUTOSCALE_FORECAST_HORIZON", float),
     ("platform", "PLATFORM", _parse_platform),
     ("coordinator_addr", "COORDINATOR_ADDR", str),
     ("controller_addr", "CONTROLLER_ADDR", str),

@@ -352,6 +352,25 @@ def _arm_obs_plane() -> None:
     if cfg.slo:
         obs_slo.arm(cfg.slo, tick_s=cfg.slo_tick_s)
 
+    # Time-series tier: bounded in-memory history over the registry
+    # (raw + 60s-downsampled rings) behind /query, flight-recorder
+    # tails, and the autoscaler's forecasts; <= 0 disables.
+    from .obs import tsdb as obs_tsdb
+    if cfg.tsdb_interval_s > 0:
+        obs_tsdb.arm(interval_s=cfg.tsdb_interval_s,
+                     retention_s=cfg.tsdb_retention_s)
+    else:
+        obs_tsdb.disarm()
+
+    # Declarative alerting over that history: pending->firing->resolved
+    # per rule, firing gauges ride the snapshot path to /cluster,
+    # transitions land in the flight recorder, state at /alertz.
+    from .obs import alerts as obs_alerts
+    if cfg.alerts:
+        obs_alerts.arm(cfg.alerts)
+    else:
+        obs_alerts.disarm()
+
     # /healthz readiness: armed only while the runtime is up, so the
     # shutdown->init window of an elastic re-rendezvous answers 503 and
     # a router probe drops this replica from rotation.
@@ -432,13 +451,17 @@ def shutdown() -> None:
         if not _state.initialized:
             return
         from .obs import aggregate as obs_aggregate
+        from .obs import alerts as obs_alerts
         from .obs import prof as obs_prof
         from .obs import server as obs_server
         from .obs import slo as obs_slo
         from .obs import tracemerge as obs_tracemerge
+        from .obs import tsdb as obs_tsdb
         obs_aggregate.stop()
         obs_tracemerge.stop()
         obs_slo.disarm()
+        obs_alerts.disarm()
+        obs_tsdb.disarm()
         # Symmetric with the arm in init(): the sampler belongs to the
         # library lifecycle, not the process.
         obs_prof.PROFILER.stop()

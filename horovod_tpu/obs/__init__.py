@@ -16,7 +16,8 @@ Stdlib-only by design; importing this package never imports jax.
 """
 
 from . import (  # noqa: F401
-    export, flightrec, perfmodel, prof, server, slo, trace, tracemerge)
+    alerts, export, flightrec, perfmodel, prof, server, slo, trace,
+    tracemerge, tsdb)
 from .registry import (  # noqa: F401
     Counter,
     DEFAULT_TIME_BUCKETS,

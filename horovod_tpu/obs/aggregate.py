@@ -423,8 +423,15 @@ class ClusterAggregator:
                     except OSError:
                         pass
                     self._kv = None
-        return merge_snapshots(
+        merged = merge_snapshots(
             [snaps[r] for r in sorted(snaps)])
+        # Every merge this process serves also extends its longitudinal
+        # fleet history (no-op unless the tsdb tier is armed) — so
+        # rank 0 / the driver can answer /query?source=cluster over the
+        # same rank-labeled series /cluster exposes instantaneously.
+        from . import tsdb
+        tsdb.ingest_cluster(merged)
+        return merged
 
     def _fetch_remote(self, timeout_ms: int, have: dict) -> dict:
         from ..runner.api import kv_get_blob

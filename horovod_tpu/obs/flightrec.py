@@ -202,6 +202,7 @@ class FlightRecorder:
                 "stall": format_stall(stall) if stall else {},
                 "metrics": _jsonsafe(REGISTRY.snapshot()),
                 "profile": self._profile_summary(),
+                "tsdb": self._tsdb_summary(),
             }
             if extra:
                 bundle["extra"] = _jsonsafe(dict(extra))
@@ -235,6 +236,18 @@ class FlightRecorder:
         try:
             from .prof import PROFILER
             return PROFILER.flight_summary()
+        except Exception:
+            return {}
+
+    @staticmethod
+    def _tsdb_summary() -> dict:
+        """Recent raw time-series tail for the curated crash set (queue
+        depth, cycle time, burn, efficiency, firing alerts) — the
+        minutes *leading up to* the event, not just its instant.  Same
+        guard: no bundle is ever lost to the tsdb tier."""
+        try:
+            from .tsdb import flight_summary
+            return flight_summary()
         except Exception:
             return {}
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -28,6 +29,38 @@ from . import export
 from .registry import REGISTRY, MetricRegistry
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: the one route table: every endpoint this server answers, with the
+#: one-liner shown on the ``/`` index — the 404 help body is derived
+#: from it too, so the endpoint list can never drift again (it used to:
+#: the hand-written 404 string omitted ``/tracez.json``).
+ROUTES = (
+    ("/metrics", "Prometheus text exposition of the process registry"),
+    ("/metrics.json", "JSON form of /metrics"),
+    ("/cluster", "merged fleet snapshot, Prometheus text (rank-labeled)"),
+    ("/cluster.json", "JSON form of /cluster"),
+    ("/query", "time-series query: ?expr=rate(m[1m])&source=local|cluster"),
+    ("/query.json", "JSON form of /query"),
+    ("/query.csv", "CSV form of /query"),
+    ("/alertz", "alert rule states (pending/firing) from HVDTPU_ALERTS"),
+    ("/alertz.json", "JSON form of /alertz"),
+    ("/tracez", "clock-aligned fleet trace (Perfetto-loadable JSON)"),
+    ("/tracez.json", "alias of /tracez"),
+    ("/profz", "self-profiler hotspot table, text"),
+    ("/profz.json", "JSON form of /profz"),
+    ("/healthz", "readiness probe: 200 ready / 503 unready"),
+)
+
+
+def _index_text() -> str:
+    width = max(len(p) for p, _ in ROUTES)
+    lines = ["horovod_tpu metrics endpoint", ""]
+    lines += [f"{p:<{width}}  {desc}" for p, desc in ROUTES]
+    return "\n".join(lines) + "\n"
+
+
+def _routes_help() -> str:
+    return "try " + ", ".join(p for p, _ in ROUTES)
 
 _ENV_VARS = ("HVDTPU_METRICS_PORT", "HOROVOD_TPU_METRICS_PORT",
              "HOROVOD_METRICS_PORT")
@@ -81,8 +114,11 @@ def set_trace_provider(fn) -> None:
 def _make_handler(registry: MetricRegistry):
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - http.server API
-            path = self.path.split("?", 1)[0]
-            if path in ("/metrics", "/"):
+            path, _, query_string = self.path.partition("?")
+            if path == "/":
+                body = _index_text()
+                ctype = "text/plain; charset=utf-8"
+            elif path == "/metrics":
                 body = export.to_prometheus(registry.snapshot())
                 ctype = PROMETHEUS_CONTENT_TYPE
             elif path == "/metrics.json":
@@ -148,11 +184,41 @@ def _make_handler(registry: MetricRegistry):
                 else:
                     body = json.dumps(PROFILER.snapshot())
                     ctype = "application/json"
+            elif path in ("/query", "/query.json", "/query.csv"):
+                from . import tsdb
+                params = urllib.parse.parse_qs(query_string)
+                expr = (params.get("expr") or [""])[0]
+                source = (params.get("source") or ["local"])[0]
+                try:
+                    result = tsdb.query(expr, source=source)
+                except tsdb.QueryError as e:
+                    self.send_error(400, str(e))
+                    return
+                if path == "/query.json":
+                    body = json.dumps(result)
+                    ctype = "application/json"
+                elif path == "/query.csv":
+                    body = tsdb.render_csv(result)
+                    ctype = "text/csv; charset=utf-8"
+                else:
+                    body = tsdb.render_text(result)
+                    ctype = "text/plain; charset=utf-8"
+            elif path in ("/alertz", "/alertz.json"):
+                from . import alerts
+                payload = alerts.status()
+                if payload is None:
+                    self.send_error(
+                        503, "alerting not armed on this process "
+                             "(set HVDTPU_ALERTS and hvd.init() arms it)")
+                    return
+                if path == "/alertz.json":
+                    body = json.dumps(payload)
+                    ctype = "application/json"
+                else:
+                    body = alerts.render_text(payload)
+                    ctype = "text/plain; charset=utf-8"
             else:
-                self.send_error(
-                    404, "try /metrics, /metrics.json, /cluster, "
-                         "/cluster.json, /tracez, /profz, /profz.json "
-                         "or /healthz")
+                self.send_error(404, _routes_help())
                 return
             payload = body.encode("utf-8")
             self.send_response(200)

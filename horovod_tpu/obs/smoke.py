@@ -27,11 +27,30 @@ import os
 import secrets
 import subprocess
 import sys
+import time
 import urllib.error
+import urllib.parse
 import urllib.request
 
-from . import export, server, slo, trace
+from . import alerts, export, server, slo, trace, tsdb
 from .registry import REGISTRY, MetricRegistry
+
+
+def _query_json(base: str, expr: str, source: str = "local") -> dict:
+    url = (f"{base}/query.json?source={source}&expr="
+           + urllib.parse.quote(expr))
+    return json.loads(urllib.request.urlopen(url, timeout=10)
+                      .read().decode())
+
+
+def _wait_for(pred, timeout_s: float = 10.0, what: str = "condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
 
 
 def _healthz(base: str):
@@ -126,11 +145,53 @@ def _process_pass() -> int:
                 return 1
         finally:
             server.set_health_provider(saved)
+        # Time-series tier: /query over sampled history + a firing
+        # alert on /alertz, end to end through the HTTP surface.
+        qc = REGISTRY.counter("smoke_tsdb_events_total",
+                              "tsdb smoke traffic")
+        try:
+            tsdb.arm(interval_s=0.05, retention_s=60.0)
+            alerts.arm("smoke_hot: smoke_tsdb_events_total >= 4 : warn",
+                       tick_s=0.05)
+            qc.inc(2)
+            tsdb.sample_now()
+            time.sleep(0.12)
+            qc.inc(2)
+            tsdb.sample_now()
+            res = _wait_for(
+                lambda: _query_json(
+                    base, "rate(smoke_tsdb_events_total[1m])")["series"],
+                what="/query rate series")
+            if res[0]["value"] <= 0:
+                print(f"obs smoke FAILED: /query rate not positive: "
+                      f"{res}", file=sys.stderr)
+                return 1
+            payload = _wait_for(
+                lambda: (lambda p: p if p["firing"] else None)(
+                    json.loads(urllib.request.urlopen(
+                        f"{base}/alertz.json", timeout=10)
+                        .read().decode())),
+                what="/alertz firing alert")
+            states = {a["alert"]: a["state"] for a in payload["alerts"]}
+            if states.get("smoke_hot") != "firing":
+                print(f"obs smoke FAILED: /alertz states {states}",
+                      file=sys.stderr)
+                return 1
+            alert_text = urllib.request.urlopen(
+                f"{base}/alertz", timeout=10).read().decode()
+            if "smoke_hot" not in alert_text:
+                print(f"obs smoke FAILED: /alertz text missing rule:\n"
+                      f"{alert_text}", file=sys.stderr)
+                return 1
+        finally:
+            alerts.disarm()
+            tsdb.disarm()
     finally:
         srv.close()
     print(f"obs smoke OK: scraped :{srv.port}/metrics "
           f"({len(text.splitlines())} lines, exposition valid; trace "
-          f"chain + SLO gauges + /healthz 200/503 verified)")
+          f"chain + SLO gauges + /healthz 200/503 + /query rate + "
+          f"/alertz firing verified)")
     return 0
 
 
@@ -236,6 +297,39 @@ def _cluster_pass() -> int:
             print(f"obs smoke FAILED: /cluster.json missing families "
                   f"({names})", file=sys.stderr)
             return 1
+        # Time-series tier over the fleet: every /cluster merge above
+        # also landed in the cluster history, so /query?source=cluster
+        # answers rank-labeled instant selectors; /alertz fires on a
+        # local series the armed sampler picked up.
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            tsdb.arm(interval_s=0.05, retention_s=60.0)
+            alerts.arm("smoke_armed: smoke_cluster_armed == 1 : info",
+                       tick_s=0.05)
+            REGISTRY.gauge("smoke_cluster_armed",
+                           "cluster-pass alert driver").set(1)
+            urllib.request.urlopen(f"{base}/cluster",
+                                   timeout=10).read()   # one ingest
+            res = _query_json(base, 'smoke_cluster_depth{rank="1"}',
+                              source="cluster")
+            if not res["series"] or res["series"][0]["value"] != 10:
+                print(f"obs smoke FAILED: cluster /query answered "
+                      f"{res}", file=sys.stderr)
+                return 1
+            payload = _wait_for(
+                lambda: (lambda p: p if p["firing"] else None)(
+                    json.loads(urllib.request.urlopen(
+                        f"{base}/alertz.json", timeout=10)
+                        .read().decode())),
+                what="cluster-pass /alertz firing alert")
+            states = {a["alert"]: a["state"] for a in payload["alerts"]}
+            if states.get("smoke_armed") != "firing":
+                print(f"obs smoke FAILED: cluster-pass /alertz states "
+                      f"{states}", file=sys.stderr)
+                return 1
+        finally:
+            alerts.disarm()
+            tsdb.disarm()
         agg.close()
     finally:
         server.set_cluster_provider(None)
@@ -244,7 +338,8 @@ def _cluster_pass() -> int:
         kv_srv.stop()
     print("obs smoke OK: /cluster aggregated 2 worker processes "
           "(rank-labeled + summed series incl. SLO attainment + trace "
-          "counters, /healthz ready, exposition valid)")
+          "counters, /healthz ready, /query over the fleet history, "
+          "/alertz firing, exposition valid)")
     return 0
 
 

@@ -611,7 +611,8 @@ def run_elastic(command: Sequence[str], args, extra_env: dict) -> int:
             burn_threshold=cfg.autoscale_burn_threshold,
             scale_up_cooldown_s=cfg.autoscale_up_cooldown_s,
             scale_down_cooldown_s=cfg.autoscale_down_cooldown_s,
-            stale_after_s=cfg.autoscale_stale_s)
+            stale_after_s=cfg.autoscale_stale_s,
+            forecast_horizon_s=cfg.autoscale_forecast_horizon_s)
     return driver.run_job(
         command, extra_env=extra_env,
         autoscale=autoscale,

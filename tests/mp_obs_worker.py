@@ -19,6 +19,12 @@ Modes (``HVDTPU_TEST_MODE``):
   bundle (dir from ``HVDTPU_FLIGHT_RECORDER_DIR``) whose stall
   attribution names rank 1 — missing-rank list AND bitmap — next to the
   event ring and the registry snapshot.
+- ``tsdb`` (np=2): the time-series tier end to end — both ranks breach
+  an ``HVDTPU_ALERTS`` rule, rank 0 asserts the firing alert on
+  ``/alertz``, rank-labeled ``hvd_alerts_firing`` from BOTH ranks on
+  ``/cluster``, ``/query`` answers over the local sampled history AND
+  the fleet history fed by the merges, and a flight-recorder bundle
+  carries the ``alert_fired`` event + the curated tsdb tail.
 - ``chaos`` (np=2): /healthz under injected faults.  Rank 1 arms a
   chaos spec delaying its negotiation check-in 2.5s; rank 0 (with
   ``HVDTPU_HEALTH_MAX_NEGOTIATION_AGE=1``) must observe its own
@@ -261,6 +267,116 @@ def cluster_mode(me: int, n: int) -> int:
     return 0
 
 
+def tsdb_mode(me: int, n: int) -> int:
+    """np=2 time-series tier: both ranks breach an HVDTPU_ALERTS rule
+    (armed through the real config surface at init), the firing gauges
+    ride the snapshot path rank-labeled onto /cluster, /alertz reports
+    the firing rule, /query answers over both the local sampled history
+    and the fleet history the /cluster merges feed, and a
+    flight-recorder bundle carries the alert event + tsdb tail."""
+    import tempfile
+    import urllib.parse
+
+    from horovod_tpu.obs import alerts, flightrec, tsdb
+
+    def query_json(port, expr, source="local"):
+        url = (f"http://127.0.0.1:{port}/query.json?source={source}"
+               "&expr=" + urllib.parse.quote(expr))
+        return json.loads(urllib.request.urlopen(url, timeout=10)
+                          .read().decode())
+
+    # Rank-distinct gauge past the alert threshold (>5) + a counter
+    # driven between two sampler ticks so rate() has a real slope.
+    REGISTRY.gauge("obs_e2e_queue", "alert driver").set(6.0 + me)
+    ticks = REGISTRY.counter("obs_e2e_ticks_total", "rate driver")
+    ticks.inc(5)
+    assert tsdb.sample_now() > 0, "tsdb sampler not armed at init"
+    time.sleep(0.15)
+    ticks.inc(5)
+    tsdb.sample_now()
+    # Alert engine ticks on its own daemon cadence (0.1s here); wait
+    # bounded for pending->firing, then make sure the firing gauge is
+    # in the published snapshot.
+    deadline = time.monotonic() + 30.0
+    while True:
+        st = alerts.status()
+        states = {a["alert"]: a["state"] for a in st["alerts"]} if st \
+            else {}
+        if states.get("e2e_queue") == "firing":
+            break
+        assert time.monotonic() < deadline, \
+            f"alert never fired on rank {me}: {st}"
+        time.sleep(0.05)
+    tsdb.sample_now()
+    assert aggregate.publish_now(), "publisher not armed or KV unreachable"
+
+    if me == 0:
+        # Local surfaces first: /alertz + /query on a live endpoint.
+        srv = server.MetricsServer(0, addr="127.0.0.1")
+        try:
+            az = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/alertz.json",
+                timeout=10).read().decode())
+            assert az["firing"] == 1, az
+            [rule] = [a for a in az["alerts"]
+                      if a["alert"] == "e2e_queue"]
+            assert rule["state"] == "firing" and \
+                rule["severity"] == "crit", rule
+            res = query_json(srv.port, "obs_e2e_queue")
+            assert res["series"][0]["value"] == 6.0, res
+            res = query_json(srv.port, "rate(obs_e2e_ticks_total[1m])")
+            assert res["series"] and res["series"][0]["value"] > 0, res
+            # Fleet history: wait for rank 1's snapshot, then /cluster
+            # must carry BOTH ranks' firing gauges rank-labeled, and
+            # every merge fed the cluster store /query reads.
+            deadline = time.monotonic() + 30.0
+            while True:
+                snap = hvd.cluster_metrics()
+                fam = _cluster_family(snap, "hvd_alerts_firing")
+                firing = {s["labels"].get("rank"): s["value"]
+                          for s in (fam["samples"] if fam else [])
+                          if s["labels"].get("alert") == "e2e_queue"
+                          and "rank" in s["labels"]}
+                if firing.get("0") == 1.0 and firing.get("1") == 1.0:
+                    break
+                assert time.monotonic() < deadline, \
+                    f"firing gauges never aggregated: {fam}"
+                time.sleep(0.2)
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/cluster",
+                timeout=10).read().decode()
+            export.validate_prometheus(text)
+            for rk in ("0", "1"):
+                assert (f'hvd_alerts_firing{{alert="e2e_queue",'
+                        f'rank="{rk}",severity="crit"}} 1') in text, text
+            res = query_json(srv.port, 'obs_e2e_queue{rank="1"}',
+                             source="cluster")
+            assert res["series"] and res["series"][0]["value"] == 7.0, res
+        finally:
+            srv.close()
+        # Flight-recorder bundle: the fired alert is on the record —
+        # event, firing gauge in the metrics snapshot, AND the curated
+        # tsdb tail shows the series leading up to it.
+        path = os.path.join(tempfile.mkdtemp(prefix="hvdtpu_tsdb_"),
+                            "bundle.json")
+        assert flightrec.RECORDER.dump(path, reason="manual") == path
+        with open(path) as fh:
+            b = json.load(fh)
+        assert any(e["kind"] == "alert_fired"
+                   and e["name"] == "e2e_queue" for e in b["events"]), \
+            [e["kind"] for e in b["events"]]
+        firing_fam = _cluster_family(b["metrics"], "hvd_alerts_firing")
+        assert firing_fam and any(
+            s["labels"].get("alert") == "e2e_queue" and s["value"] == 1
+            for s in firing_fam["samples"]), firing_fam
+        tails = {s["name"]: s for s in b["tsdb"]["series"]}
+        assert "hvd_alerts_firing" in tails, b["tsdb"]
+        assert tails["hvd_alerts_firing"]["points"][-1][1] == 1.0, tails
+    hvd.barrier()
+    print(f"rank {me}: TSDB-OK")
+    return 0
+
+
 def _healthz_code(port: int) -> int:
     import urllib.error
     try:
@@ -435,6 +551,12 @@ def main() -> int:
         # counts good and attainment is exactly 1.0 on both ranks.
         os.environ.setdefault(
             "HVDTPU_SLO", "e2e=p99(obs_e2e_lat_seconds) < 200ms over 5m")
+    elif mode == "tsdb":
+        # Fast sampler cadence + one alert rule, both through the real
+        # config surface — init() arms the tier exactly like production.
+        os.environ.setdefault("HVDTPU_TSDB_INTERVAL", "0.1")
+        os.environ.setdefault(
+            "HVDTPU_ALERTS", "e2e_queue: obs_e2e_queue > 5 : crit")
     hvd.init()
     me, n = hvd.cross_rank(), hvd.cross_size()
     if mode == "cluster":
@@ -445,6 +567,8 @@ def main() -> int:
         rc = flightrec_mode(me, n)
     elif mode == "chaos":
         rc = chaos_mode(me, n)
+    elif mode == "tsdb":
+        rc = tsdb_mode(me, n)
     else:
         raise SystemExit(f"unknown HVDTPU_TEST_MODE={mode!r}")
     hvd.shutdown()

@@ -93,7 +93,8 @@ def main() -> int:
     hvd.shutdown()
 
     import json
-    events = json.load(open(tl_path))
+    from horovod_tpu.utils.timeline import rank_suffixed
+    events = json.load(open(rank_suffixed(tl_path, me, n)))
     spans = [e["name"] for e in events if e.get("ph") == "B"]
     for phase in ("QUEUE", "NEGOTIATE", "DISPATCH"):
         assert phase in spans, f"timeline missing {phase}: {spans[:20]}"

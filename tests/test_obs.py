@@ -472,6 +472,20 @@ def test_cluster_serving_trace_e2e_np2():
 
 
 @pytest.mark.integration
+def test_tsdb_alerts_and_query_over_cluster_np2():
+    """Acceptance (tsdb tier): at np=2 with HVDTPU_ALERTS armed through
+    the real config surface, a breached rule fires on BOTH ranks and the
+    firing gauges arrive rank-labeled on /cluster; /alertz reports the
+    firing state; /query answers over the local sampled history AND the
+    fleet history fed by the /cluster merges; a flight-recorder bundle
+    carries the alert_fired event and the curated tsdb tail."""
+    res = _hvdrun(2, extra_env={"HVDTPU_TEST_MODE": "tsdb"})
+    assert res.returncode == 0, res.stdout + res.stderr
+    for r in range(2):
+        assert f"rank {r}: TSDB-OK" in res.stdout, res.stdout
+
+
+@pytest.mark.integration
 @pytest.mark.slow
 def test_healthz_transitions_under_injected_faults_np2():
     """Acceptance (chaos satellite): with a fault spec stalling rank 1's

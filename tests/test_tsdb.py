@@ -1,0 +1,616 @@
+"""Time-series tier (obs/tsdb) + declarative alerting (obs/alerts) +
+forecast-fed predictive autoscaling.
+
+All stores here run on synthetic timestamps (ingest/eval take explicit
+``now``) and the alert engine on a fake clock, so every lifecycle and
+every rate is exactly reproducible.  The HTTP tests stand up a real
+``MetricsServer`` and go through ``/query`` / ``/alertz`` — the
+acceptance surface — with the process-wide tier armed around them.
+"""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from horovod_tpu.autoscale.controller import (
+    AutoscaleController,
+    signals_from_families,
+)
+from horovod_tpu.autoscale.policy import PolicyConfig, ScalePolicy, Signals
+from horovod_tpu.obs import REGISTRY, alerts, flightrec, server, tsdb
+from horovod_tpu.obs.tsdb import QueryError, SeriesStore
+
+T0 = 1_000_000.0
+
+
+def _gauge_fam(name, value, labels=None):
+    return {"name": name, "type": "gauge", "help": "",
+            "labelnames": tuple((labels or {}).keys()),
+            "samples": [{"labels": dict(labels or {}), "value": value}]}
+
+
+def _counter_fam(name, value, labels=None):
+    fam = _gauge_fam(name, value, labels)
+    fam["type"] = "counter"
+    return fam
+
+
+def _hist_fam(name, buckets, total, hsum, labels=None):
+    return {"name": name, "type": "histogram", "help": "",
+            "labelnames": tuple((labels or {}).keys()),
+            "samples": [{"labels": dict(labels or {}),
+                         "buckets": buckets + [[float("inf"), total]],
+                         "sum": hsum, "count": total}]}
+
+
+class FakeClock:
+    def __init__(self, t=T0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# rings: bounds + downsample math
+# ---------------------------------------------------------------------------
+
+def test_raw_ring_is_bounded_by_retention():
+    store = SeriesStore(interval_s=1.0, retention_s=10.0)
+    for i in range(500):
+        store.ingest([_gauge_fam("g", float(i))], now=T0 + i)
+    [(_, ser)] = store.select("g")
+    assert len(ser.raw) == store.raw_len == 11
+    # oldest raw point slid forward with the window
+    assert ser.raw[0][0] == T0 + 500 - 11
+
+def test_store_total_memory_is_bounded_at_default_retention():
+    """Acceptance: tsdb memory stays bounded — the retained point count
+    never exceeds the analytic cap no matter how long sampling runs."""
+    store = SeriesStore()    # default 5s interval / 600s retention
+    for i in range(3 * store.raw_len):
+        store.ingest([_gauge_fam("g", float(i)),
+                      _counter_fam("c_total", float(i))],
+                     now=T0 + i * store.interval_s)
+    cap = store.max_series * (store.raw_len + store.ds_len + 1)
+    assert store.n_points() <= cap
+    # and per-series: raw ring exactly at its maxlen, ds ring bounded
+    for _, ser in store.select("g") + store.select("c_total"):
+        assert len(ser.raw) == store.raw_len
+        assert len(ser.ds) <= store.ds_len
+
+
+def test_series_cap_drops_new_series_not_the_process():
+    store = SeriesStore(interval_s=1.0, max_series=4)
+    fams = [_gauge_fam("g", 1.0, {"k": str(i)}) for i in range(10)]
+    store.ingest(fams, now=T0)
+    assert store.n_series() == 4
+    # existing series still append fine
+    store.ingest(fams, now=T0 + 1)
+    assert store.n_series() == 4
+
+
+def test_downsample_buckets_carry_last_min_max_sum_n():
+    store = SeriesStore(interval_s=1.0, retention_s=5.0)
+    # two full 60s buckets of a sawtooth, then one point to finalize
+    vals = {}
+    for i in range(121):
+        v = float(i % 7)
+        vals[i] = v
+        store.ingest([_gauge_fam("g", v)], now=T0 + i)
+    [(_, ser)] = store.select("g")
+    assert len(ser.ds) >= 1
+    t_last, last, vmin, vmax, vsum, n = ser.ds[0]
+    lo = [vals[i] for i in range(121)
+          if (T0 + i) // 60 == T0 // 60]     # first bucket members
+    assert n == len(lo)
+    assert vmin == min(lo) and vmax == max(lo)
+    assert vsum == sum(lo)
+    assert last == lo[-1]
+
+
+def test_window_spans_merge_downsampled_history_with_raw():
+    store = SeriesStore(interval_s=1.0, retention_s=10.0)
+    for i in range(300):
+        store.ingest([_gauge_fam("g", float(i))], now=T0 + i)
+    now = T0 + 299
+    # max over 4 minutes: raw holds only the last ~10s, so the answer
+    # must come from the downsampled ring
+    res = tsdb.eval_expr(store, "max_over_time(g[4m])", now=now)
+    assert res["series"][0]["value"] == 299.0
+    res = tsdb.eval_expr(store, "min_over_time(g[4m])", now=now)
+    assert res["series"][0]["value"] < 290.0   # reached back past raw
+
+
+# ---------------------------------------------------------------------------
+# reset-aware rate
+# ---------------------------------------------------------------------------
+
+def test_rate_matches_analytic_value_exactly():
+    store = SeriesStore(interval_s=1.0)
+    for i, v in enumerate([0.0, 7.0, 14.0, 21.0, 28.0]):
+        store.ingest([_counter_fam("c_total", v)], now=T0 + 2 * i)
+    res = tsdb.eval_expr(store, "rate(c_total[5m])", now=T0 + 8)
+    assert abs(res["series"][0]["value"] - 3.5) < 1e-6
+
+def test_rate_across_counter_reset_is_reset_aware():
+    """Acceptance: the post-reset value counts as the increase since the
+    restart (Prometheus convention), within 1e-6 of analytic."""
+    store = SeriesStore(interval_s=1.0)
+    vals = [0.0, 5.0, 10.0, 15.0, 2.0, 7.0, 12.0]   # restart after 15
+    for i, v in enumerate(vals):
+        store.ingest([_counter_fam("c_total", v)], now=T0 + i)
+    analytic = (15.0 + 12.0) / 6.0
+    res = tsdb.eval_expr(store, "rate(c_total[10m])", now=T0 + 6)
+    assert abs(res["series"][0]["value"] - analytic) < 1e-6
+    res = tsdb.eval_expr(store, "increase(c_total[10m])", now=T0 + 6)
+    assert abs(res["series"][0]["value"] - 27.0) < 1e-6
+
+
+def test_rate_functions_need_two_points():
+    store = SeriesStore(interval_s=1.0)
+    store.ingest([_counter_fam("c_total", 5.0)], now=T0)
+    res = tsdb.eval_expr(store, "rate(c_total[1m])", now=T0)
+    assert res["series"] == []   # omitted, not an error
+
+
+# ---------------------------------------------------------------------------
+# query language: parse/eval goldens
+# ---------------------------------------------------------------------------
+
+def test_parse_expr_goldens():
+    p = tsdb.parse_expr('rate(m{pool="decode",rank="1"}[1m])')
+    assert p["fn"] == "rate" and p["name"] == "m"
+    assert p["matchers"] == {"pool": "decode", "rank": "1"}
+    assert p["window_s"] == 60.0
+    p = tsdb.parse_expr("avg_over_time(q[90s])")
+    assert (p["fn"], p["window_s"]) == ("avg_over_time", 90.0)
+    p = tsdb.parse_expr("quantile(0.99, h[5m])")
+    assert (p["fn"], p["q"], p["window_s"]) == ("quantile", 0.99, 300.0)
+    p = tsdb.parse_expr("forecast(q[2m], 30)")
+    assert (p["fn"], p["horizon_s"]) == ("forecast", 30.0)
+    p = tsdb.parse_expr('up{job="x"}')
+    assert (p["fn"], p["matchers"]) == ("instant", {"job": "x"})
+
+@pytest.mark.parametrize("bad", [
+    "", "rate(m)", "m[1m]", "rate(m[1x])", "quantile(m[1m])",
+    "quantile(1.5, h[1m])", "nope(m[1m])", 'm{broken=}',
+    "forecast(q[1m])",
+])
+def test_parse_expr_rejects_malformed(bad):
+    with pytest.raises(QueryError):
+        tsdb.parse_expr(bad)
+
+
+def test_eval_label_matchers_filter_series():
+    store = SeriesStore(interval_s=1.0)
+    for rank in ("0", "1"):
+        store.ingest([_gauge_fam("q", 10.0 * (int(rank) + 1),
+                                 {"rank": rank})], now=T0)
+    res = tsdb.eval_expr(store, 'q{rank="1"}', now=T0)
+    assert [s["value"] for s in res["series"]] == [20.0]
+    res = tsdb.eval_expr(store, "q", now=T0)
+    assert [s["value"] for s in res["series"]] == [10.0, 20.0]
+
+
+def test_eval_quantile_over_histogram_window_delta():
+    store = SeriesStore(interval_s=1.0)
+    # 10 fast observations first, then 10 slow ones; a window covering
+    # only the slow delta must quantile near the slow bucket.
+    store.ingest([_hist_fam("lat", [[0.01, 10], [0.1, 10]], 10, 0.05)],
+                 now=T0)
+    store.ingest([_hist_fam("lat", [[0.01, 10], [0.1, 20]], 20, 1.0)],
+                 now=T0 + 100)
+    res = tsdb.eval_expr(store, "quantile(0.5, lat[1m])", now=T0 + 100)
+    v = res["series"][0]["value"]
+    assert 0.01 < v <= 0.1, v
+    # scalar companions exist with counter semantics
+    res = tsdb.eval_expr(store, "rate(lat_count[10m])", now=T0 + 100)
+    assert abs(res["series"][0]["value"] - 0.1) < 1e-6
+
+
+def test_eval_scalar_fn_on_histogram_is_an_error():
+    store = SeriesStore(interval_s=1.0)
+    store.ingest([_hist_fam("lat", [[0.01, 1]], 1, 0.001)], now=T0)
+    with pytest.raises(QueryError):
+        tsdb.eval_expr(store, "rate(lat[1m])", now=T0)
+
+
+def test_render_text_and_csv():
+    store = SeriesStore(interval_s=1.0)
+    store.ingest([_gauge_fam("q", 3.0, {"rank": "0"})], now=T0)
+    res = tsdb.eval_expr(store, "q", now=T0)
+    assert tsdb.render_text(res) == '{rank="0"} 3\n'
+    assert tsdb.render_csv(res) == 'labels,value\n"rank=0",3\n'
+
+
+# ---------------------------------------------------------------------------
+# forecast
+# ---------------------------------------------------------------------------
+
+def test_forecast_recovers_linear_ramp():
+    pts = [(T0 + i, 2.0 + 0.5 * i) for i in range(30)]
+    v = tsdb.forecast_points(pts, 60.0)
+    want = 2.0 + 0.5 * (29 + 60)
+    assert abs(v - want) < 1e-6
+
+def test_forecast_is_robust_to_an_outlier():
+    pts = [(T0 + i, 1.0 * i) for i in range(30)]
+    pts[13] = (T0 + 13, 500.0)    # one scrape hiccup
+    v = tsdb.forecast_points(pts, 30.0)
+    assert abs(v - (29 + 30)) < 2.0   # Theil-Sen shrugs it off
+
+def test_forecast_degrades_gracefully_on_tiny_series():
+    assert tsdb.forecast_points([], 30.0) is None
+    assert tsdb.forecast_points([(T0, 4.0)], 30.0) == 4.0
+    assert tsdb.forecast_points([(T0, 4.0), (T0 + 1, 5.0)], 30.0) == 5.0
+
+
+def test_forecast_expr_through_the_query_layer():
+    store = SeriesStore(interval_s=1.0)
+    for i in range(20):
+        store.ingest([_gauge_fam("q", 0.5 * i)], now=T0 + i)
+    res = tsdb.eval_expr(store, "forecast(q[60s], 30)", now=T0 + 19)
+    assert abs(res["series"][0]["value"] - (9.5 + 15.0)) < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# alert engine state machine (FakeClock => fully deterministic)
+# ---------------------------------------------------------------------------
+
+def _alert_engine(spec, store, clk):
+    return alerts.AlertEngine(alerts.parse_rules(spec), store=store,
+                              clock=clk)
+
+
+def _set_queue(store, clk, v):
+    store.ingest([_gauge_fam("q", v)], now=clk())
+
+
+def test_alert_parse_grammar():
+    rules = alerts.parse_rules(
+        "queue: avg_over_time(hvd_serving_queue_depth[1m]) > 8 "
+        "for 30s : warn; burn: max_over_time(b[5m]) >= 14.4 : page; "
+        "floor: q < 1")
+    assert [(r.name, r.op, r.threshold, r.for_s, r.severity)
+            for r in rules] == [
+        ("queue", ">", 8.0, 30.0, "warn"),
+        ("burn", ">=", 14.4, 0.0, "page"),
+        ("floor", "<", 1.0, 0.0, "warn"),
+    ]
+
+@pytest.mark.parametrize("bad", [
+    "rate(m[1m]) > 2",          # no name
+    "a: m >",                   # no threshold
+    "a: m > 1 : sideways",      # bad severity is not silently dropped
+    "a: nope(m[1m]) > 1",       # expression must parse
+    "a: m > 1; a: m > 2",       # duplicate names
+])
+def test_alert_parse_rejects_malformed(bad):
+    with pytest.raises(QueryError):
+        alerts.parse_rules(bad)
+
+
+def test_alert_pending_hold_then_firing_then_resolve():
+    clk = FakeClock()
+    store = SeriesStore(interval_s=1.0)
+    eng = _alert_engine("hot: q > 8 for 10s : crit", store, clk)
+    _set_queue(store, clk, 9.0)
+    eng.tick()
+    assert eng.status()["alerts"][0]["state"] == "pending"
+    clk.advance(5)
+    _set_queue(store, clk, 9.5)
+    eng.tick()     # held only 5s of 10s
+    assert eng.status()["alerts"][0]["state"] == "pending"
+    clk.advance(5)
+    _set_queue(store, clk, 9.5)
+    eng.tick()     # 10s held: fires
+    st = eng.status()["alerts"][0]
+    assert st["state"] == "firing" and st["fired_total"] == 1
+    clk.advance(1)
+    _set_queue(store, clk, 2.0)
+    eng.tick()
+    st = eng.status()["alerts"][0]
+    assert st["state"] == "inactive" and st["resolved_total"] == 1
+
+def test_alert_flap_inside_hold_never_fires():
+    clk = FakeClock()
+    store = SeriesStore(interval_s=1.0)
+    eng = _alert_engine("hot: q > 8 for 10s", store, clk)
+    for v in (9.0, 2.0, 9.0, 2.0, 9.0, 2.0):
+        _set_queue(store, clk, v)
+        eng.tick()
+        clk.advance(4)
+    st = eng.status()["alerts"][0]
+    assert st["fired_total"] == 0 and st["state"] != "firing"
+
+
+def test_alert_zero_hold_fires_immediately_and_sets_gauges():
+    clk = FakeClock()
+    store = SeriesStore(interval_s=1.0)
+    eng = _alert_engine("hot_now: q >= 5 : page", store, clk)
+    _set_queue(store, clk, 5.0)
+    eng.tick()
+    assert eng.status()["alerts"][0]["state"] == "firing"
+    snap = {f["name"]: f for f in REGISTRY.snapshot()}
+    [s] = [s for s in snap["hvd_alerts_firing"]["samples"]
+           if s["labels"].get("alert") == "hot_now"]
+    assert s["value"] == 1.0 and s["labels"]["severity"] == "page"
+    # and the transition is on the flight-recorder ring
+    kinds = [(e["kind"], e["name"]) for e in flightrec.RECORDER.snapshot()]
+    assert ("alert_fired", "hot_now") in kinds
+
+
+def test_alert_lifecycle_is_deterministic():
+    """Same inputs => same transition sequence, twice over."""
+    def run():
+        clk = FakeClock()
+        store = SeriesStore(interval_s=1.0)
+        eng = _alert_engine("hot: q > 8 for 6s", store, clk)
+        seen = []
+        for v in (9, 9, 9, 2, 9, 9, 9, 9, 1):
+            _set_queue(store, clk, v)
+            eng.tick()
+            seen.append(eng.status()["alerts"][0]["state"])
+            clk.advance(3)
+        return seen
+    assert run() == run()
+    assert run() == ["pending", "pending", "firing", "inactive",
+                     "pending", "pending", "firing", "firing",
+                     "inactive"]
+
+
+def test_alert_lt_comparison_alerts_on_min_series():
+    clk = FakeClock()
+    store = SeriesStore(interval_s=1.0)
+    store.ingest([_gauge_fam("q", 9.0, {"rank": "0"}),
+                  _gauge_fam("q", 0.2, {"rank": "1"})], now=clk())
+    eng = _alert_engine("starved: q < 1", store, clk)
+    eng.tick()
+    st = eng.status()["alerts"][0]
+    assert st["state"] == "firing" and st["value"] == 0.2
+
+
+# ---------------------------------------------------------------------------
+# predictive autoscaling
+# ---------------------------------------------------------------------------
+
+def _ramp_families(depth):
+    return [
+        {"name": "horovod_tpu_rank_snapshot_age_seconds", "type": "gauge",
+         "help": "", "labelnames": ("rank", "stale"),
+         "samples": [{"labels": {"rank": "0", "stale": "false"},
+                      "value": 0.0}]},
+        _gauge_fam("hvd_serving_queue_depth", depth, {"rank": "0"}),
+    ]
+
+
+def test_signals_carry_queue_forecast_from_store():
+    store = SeriesStore(interval_s=1.0)
+    for i in range(12):
+        store.ingest(_ramp_families(0.5 * i), now=T0 + i)
+    sig = signals_from_families(
+        _ramp_families(5.5), current_np=2, available_slots=4,
+        store=store, forecast_horizon_s=30.0, now=T0 + 11)
+    assert sig.queue_forecast is not None
+    assert abs(sig.queue_forecast - (5.5 + 15.0)) < 1e-6
+    # horizon 0 = off
+    sig = signals_from_families(
+        _ramp_families(5.5), current_np=2, available_slots=4,
+        store=store, forecast_horizon_s=0.0, now=T0 + 11)
+    assert sig.queue_forecast is None
+
+def test_policy_grows_on_predicted_breach_before_threshold():
+    clk = FakeClock()
+    pol = ScalePolicy(PolicyConfig(
+        min_np=2, max_np=4, queue_high=8.0, forecast_horizon_s=30.0,
+        scale_up_cooldown_s=0.0), clock=clk)
+    d = pol.decide(Signals(current_np=2, available_slots=4,
+                           queue_depth=3.0, queue_forecast=16.0))
+    assert d.action == "grow_predicted" and d.target_np == 4
+    assert "forecast" in d.reason
+
+
+def test_policy_predicted_grow_respects_cooldown_and_capacity():
+    clk = FakeClock()
+    pol = ScalePolicy(PolicyConfig(
+        min_np=2, max_np=4, queue_high=8.0, forecast_horizon_s=30.0,
+        scale_up_cooldown_s=30.0), clock=clk)
+    sig = Signals(current_np=2, available_slots=4, queue_depth=3.0,
+                  queue_forecast=16.0)
+    assert pol.decide(sig).action == "hold"     # construction stamp
+    clk.advance(31)
+    d = pol.decide(sig)
+    assert d.action == "grow_predicted"
+    clk.advance(5)
+    assert pol.decide(sig).action == "hold"     # shared up-cooldown
+    # at capacity: hold, not grow
+    clk.advance(31)
+    d = pol.decide(Signals(current_np=4, available_slots=4,
+                           queue_depth=3.0, queue_forecast=16.0))
+    assert d.action == "hold" and "capacity" in d.reason
+
+
+def test_policy_forecast_off_by_default():
+    pol = ScalePolicy(PolicyConfig(min_np=2, max_np=4, queue_high=8.0,
+                                   scale_up_cooldown_s=0.0),
+                      clock=FakeClock())
+    d = pol.decide(Signals(current_np=2, available_slots=4,
+                           queue_depth=3.0, queue_forecast=16.0))
+    assert d.action == "hold"   # hysteresis band, forecast ignored
+
+
+def test_controller_predictive_grow_end_to_end():
+    """Ramping queue through the real controller + its tsdb history:
+    grow_predicted fires (and bumps) while the instantaneous depth is
+    still below queue_high."""
+    clk = FakeClock()
+    depth = [0.0]
+    bumps = []
+    pol = ScalePolicy(PolicyConfig(
+        min_np=2, max_np=4, queue_high=8.0, forecast_horizon_s=30.0,
+        scale_up_cooldown_s=0.0), clock=clk)
+    ctl = AutoscaleController(
+        pol, current_np=2, collect=lambda: _ramp_families(depth[0]),
+        bump=lambda: bumps.append(1), capacity=lambda: 4,
+        store=SeriesStore(interval_s=1.0), clock=clk)
+    fired_at = None
+    for _ in range(20):
+        d = ctl.poll_once()
+        if d.action == "grow_predicted":
+            fired_at = depth[0]
+            break
+        clk.advance(1.0)
+        depth[0] += 0.5
+    assert fired_at is not None and fired_at < 8.0, fired_at
+    assert bumps == [1]
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: /query, /alertz, the route table
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def armed_tier():
+    tsdb.arm(interval_s=3600.0, retention_s=7200.0)  # manual ticks only
+    srv = server.MetricsServer(0, addr="127.0.0.1")
+    try:
+        yield srv
+    finally:
+        srv.close()
+        alerts.disarm()
+        tsdb.disarm()
+
+
+def _get(port, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10).read().decode()
+
+
+def test_query_endpoint_rate_within_1e6_of_analytic(armed_tier):
+    """Acceptance: GET /query?expr=rate(...[1m]) over a synthetic
+    counter driven at a known rate, including across a reset."""
+    import time as _time
+    c = REGISTRY.counter("tsdb_http_events_total", "query acceptance")
+    now = _time.time()
+    c.inc(3)
+    tsdb.sample_now(now - 20)
+    c.inc(6)
+    tsdb.sample_now(now - 10)
+    blob = json.loads(_get(
+        armed_tier.port, "/query.json?expr=" + urllib.parse.quote(
+            "rate(tsdb_http_events_total[1m])")))
+    assert abs(blob["series"][0]["value"] - 0.6) < 1e-6
+    # reset: registry reset drops the counter to a lower value
+    fams = [_counter_fam("tsdb_http_events_total", 2.0)]
+    tsdb.local_store().ingest(fams, now=now)
+    blob = json.loads(_get(
+        armed_tier.port, "/query.json?expr=" + urllib.parse.quote(
+            "rate(tsdb_http_events_total[1m])")))
+    analytic = (6.0 + 2.0) / 20.0
+    assert abs(blob["series"][0]["value"] - analytic) < 1e-6
+    # text + csv renderings answer too
+    assert _get(armed_tier.port, "/query.csv?expr=" + urllib.parse.quote(
+        "rate(tsdb_http_events_total[1m])")).startswith("labels,value")
+
+def test_query_endpoint_rejects_bad_exprs_with_400(armed_tier):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(armed_tier.port, "/query?expr=" +
+             urllib.parse.quote("nope(m[1m])"))
+    assert ei.value.code == 400
+
+
+def test_alertz_endpoint_serves_engine_state(armed_tier):
+    REGISTRY.gauge("tsdb_http_alert_gauge", "alertz acceptance").set(9.0)
+    tsdb.sample_now()
+    eng = alerts.arm("http_hot: tsdb_http_alert_gauge > 5 : warn",
+                     tick_s=3600.0)
+    eng.tick()
+    blob = json.loads(_get(armed_tier.port, "/alertz.json"))
+    assert blob["firing"] == 1
+    [a] = [a for a in blob["alerts"] if a["alert"] == "http_hot"]
+    assert a["state"] == "firing"
+    assert "http_hot" in _get(armed_tier.port, "/alertz")
+
+
+def test_alertz_answers_503_when_unarmed(armed_tier):
+    alerts.disarm()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(armed_tier.port, "/alertz")
+    assert ei.value.code == 503
+
+
+def test_route_table_drives_index_and_404():
+    """Satellite: the 404 help and the / index derive from one route
+    table — every route (incl. /tracez.json, the one the old string
+    missed) appears in both."""
+    srv = server.MetricsServer(0, addr="127.0.0.1")
+    try:
+        index = _get(srv.port, "/")
+        for path, _ in server.ROUTES:
+            assert path in index, (path, index)
+        try:
+            _get(srv.port, "/definitely-not-a-route")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            body = e.read().decode()
+            for path, _ in server.ROUTES:
+                assert path in body, (path, body)
+    finally:
+        srv.close()
+
+
+def test_query_unarmed_is_a_clear_error():
+    tsdb.disarm()
+    srv = server.MetricsServer(0, addr="127.0.0.1")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.port, "/query?expr=up")
+        assert ei.value.code == 400
+        assert "not armed" in ei.value.read().decode()
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder tsdb tail
+# ---------------------------------------------------------------------------
+
+def test_flightrec_bundle_carries_tsdb_tail(tmp_path):
+    import time as _time
+    # hour-long interval => after its arm-time tick the background
+    # sampler never fires again during the test; the wide retention
+    # keeps the raw ring deep enough.  Timestamps run FORWARD from real
+    # now (the arm-time tick already stamped now, and earlier suite
+    # tests may have seeded the series) so none are rejected as
+    # out-of-order.
+    tsdb.arm(interval_s=3600.0, retention_s=86400.0)
+    try:
+        g = REGISTRY.gauge("hvd_serving_queue_depth", "queue depth")
+        base = _time.time() + 1.0
+        for i in range(5):
+            g.set(float(i))
+            tsdb.sample_now(base + i)
+        path = str(tmp_path / "bundle.json")
+        assert flightrec.RECORDER.dump(path, reason="manual") == path
+        b = json.loads(open(path).read())
+        tails = {s["name"]: s["points"] for s in b["tsdb"]["series"]}
+        assert "hvd_serving_queue_depth" in tails, b["tsdb"]
+        assert [p[1] for p in tails["hvd_serving_queue_depth"]][-5:] == \
+            [0.0, 1.0, 2.0, 3.0, 4.0]
+    finally:
+        tsdb.disarm()
+
+
+def test_flightrec_tsdb_key_empty_when_unarmed(tmp_path):
+    tsdb.disarm()
+    path = str(tmp_path / "bundle.json")
+    assert flightrec.RECORDER.dump(path, reason="manual") == path
+    assert json.loads(open(path).read())["tsdb"] == {}
